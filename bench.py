@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""Benchmark driver: batched ECDSA-P256 verification throughput on device.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+The headline metric matches BASELINE.json: ECDSA-P256 verifies/sec/chip on
+the device batch verifier vs. the software CSP (the `sw` provider, backed by
+OpenSSL via the `cryptography` package — the analog of the reference's
+bccsp/sw, bccsp/sw/ecdsa.go:41).
+"""
+import json
+import sys
+import time
+
+
+def main() -> None:
+    # Placeholder until the kernels land: measure the sw provider only and
+    # report 1.0x. Replaced by the real device-vs-host comparison in task 9.
+    value = 0.0
+    vs = 0.0
+    print(json.dumps({
+        "metric": "ecdsa_p256_verifies_per_sec",
+        "value": value,
+        "unit": "verifies/s",
+        "vs_baseline": vs,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+"""fabric_mod_tpu — a TPU-native permissioned-ledger framework.
+
+A from-scratch framework with the capabilities of Hyperledger Fabric
+(reference: trustbloc/fabric-mod): pluggable crypto provider (BCCSP),
+membership services (MSP), signature-policy engine, endorse/order/validate/
+commit transaction flow, ordering service, gossip dissemination, and a
+versioned KV ledger with MVCC.
+
+The design is TPU-first: the block-commit path's compute — batched
+ECDSA-P256 signature verification, SHA-256 hashing, and endorsement-policy
+evaluation — runs as JAX kernels on device (see ``fabric_mod_tpu.ops``),
+fed by a host-side batching provider (``fabric_mod_tpu.bccsp.tpu_provider``)
+behind the same pluggable boundary the reference exposes
+(reference: bccsp/bccsp.go:90, core/peer/peer.go:313).
+
+Layer map (mirrors SURVEY.md §1):
+  protos/    L0 wire types + canonical codec
+  ops/       device kernels (limb bignum, P-256, ECDSA, SHA-256)
+  bccsp/     L1 crypto provider (sw + tpu batch provider + factory)
+  msp/       L1 identity (certs, validation, principal matching)
+  policy/    L2 signature-policy compiler + vectorized evaluation
+  ledger/    L3 block store, versioned state DB, MVCC
+  orderer/   L5 ordering service (blockcutter, solo/raft consenters)
+  peer/      L5 commit pipeline (txvalidator, committer), endorser
+  gossip/    L4 dissemination (membership, anti-entropy, state transfer)
+  parallel/  device mesh / sharding utilities (dp sharding of verify batches)
+  utils/     logging, metrics, config
+"""
+
+__version__ = "0.1.0"
