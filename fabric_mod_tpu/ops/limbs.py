@@ -1,0 +1,304 @@
+"""Vectorized 256-bit modular arithmetic for TPU.
+
+Represents field elements as K=25 signed int32 limbs in radix B=2**11,
+little-endian, with *lazy carries*: between operations limbs satisfy
+|limb| < 2**12, so every schoolbook product column (up to K terms of
+|a_i*b_j| < 2**24) stays below 2**29 — comfortably inside int32 — and
+carry propagation is two fully-parallel local passes (no sequential scan
+on the hot path). Signed limbs make subtraction a plain limb-wise
+subtract with no borrow handling.
+
+Modular multiplication is Montgomery in *separated* form with R = 2**275:
+
+    T = a*b                       (schoolbook, 2K-1 columns)
+    m = (T mod R) * N' mod R      (low-K schoolbook; N' = -p^-1 mod R)
+    out = (T + m*p) / R           (exact; low K limbs telescope to zero)
+
+Value-bound analysis (used throughout, do not change K/B casually):
+inputs |v| < 2**262 give |T|/R < 2**249 and |m*p|/R < 2**257.3, so
+outputs are < 2**258 — the chain is self-stabilizing. The only
+sequential pieces are the exact carry over the low K limbs of T + m*p
+(K steps) and final canonicalization.
+
+All functions treat the last axis as limbs and broadcast over leading
+batch axes, so no vmap is required; lax.scan bodies stay batched.
+
+This layer is the TPU-native answer to the reference's software crypto
+in bccsp/sw (reference: bccsp/sw/ecdsa.go:41-57 verify path) — there the
+per-signature math is Go stdlib crypto/elliptic; here the batch axis is
+the parallelism (SURVEY.md §2.9).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+K = 25          # number of limbs
+B = 11          # bits per limb
+MASK = (1 << B) - 1
+RBITS = K * B   # 275
+
+
+# ---------------------------------------------------------------------------
+# Host-side converters (numpy; vectorized over batch)
+# ---------------------------------------------------------------------------
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Convert a non-negative python int (< 2**RBITS) to K limbs."""
+    assert 0 <= x < (1 << RBITS)
+    out = np.zeros(K, np.int32)
+    for i in range(K):
+        out[i] = x & MASK
+        x >>= B
+    return out
+
+
+def limbs_to_int(a) -> int:
+    """Exact value of a (possibly lazy, signed) limb array -> python int."""
+    a = np.asarray(a)
+    assert a.ndim == 1
+    return sum(int(v) << (B * i) for i, v in enumerate(a.tolist()))
+
+
+def be_bytes_to_limbs(buf: np.ndarray) -> np.ndarray:
+    """(..., 32) uint8 big-endian byte strings -> (..., K) int32 limbs.
+
+    Vectorized over the batch; used to marshal digests/coordinates/scalars
+    onto the device.
+    """
+    buf = np.asarray(buf, np.uint8)
+    assert buf.shape[-1] == 32
+    # little-endian bit order over the whole 256-bit integer
+    bits = np.unpackbits(buf[..., ::-1], axis=-1, bitorder="little")  # (...,256)
+    pad = np.zeros(bits.shape[:-1] + (RBITS - 256,), np.uint8)
+    bits = np.concatenate([bits, pad], axis=-1)
+    bits = bits.reshape(bits.shape[:-1] + (K, B))
+    weights = (1 << np.arange(B)).astype(np.int32)
+    return (bits.astype(np.int32) * weights).sum(-1).astype(np.int32)
+
+
+def limbs_to_be_bytes(a: np.ndarray) -> np.ndarray:
+    """Canonical non-negative (..., K) limbs -> (..., 32) big-endian bytes."""
+    a = np.asarray(a, np.int64)
+    bits = ((a[..., :, None] >> np.arange(B)) & 1).astype(np.uint8)
+    bits = bits.reshape(a.shape[:-1] + (RBITS,))[..., :256]
+    by = np.packbits(bits, axis=-1, bitorder="little")  # (..., 32) LE
+    return by[..., ::-1].copy()
+
+
+# ---------------------------------------------------------------------------
+# Field specification (per modulus): Montgomery constants
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class FieldSpec:
+    """Montgomery constants for one odd modulus, as device arrays."""
+    name: str
+    modulus: int                 # python int, for host-side math/tests
+    p: jnp.ndarray               # (K,) canonical limbs of modulus
+    nprime: jnp.ndarray          # (K,) canonical limbs of -p^-1 mod R
+    r2: jnp.ndarray              # (K,) R^2 mod p   (to_mont multiplier)
+    one: jnp.ndarray             # (K,) limbs of 1
+    one_mont: jnp.ndarray        # (K,) R mod p     (Montgomery one)
+    kp: jnp.ndarray              # (9, K) canonical limbs of [128p,64p,...,p, 0]
+    mp128: jnp.ndarray           # (K,) canonical limbs of 128p (sign lift)
+
+    @staticmethod
+    @functools.lru_cache(maxsize=None)
+    def make(name: str, modulus: int) -> "FieldSpec":
+        R = 1 << RBITS
+        nprime = (-pow(modulus, -1, R)) % R
+        r2 = (R * R) % modulus
+        kps = [int_to_limbs((128 >> i) * modulus) for i in range(8)]
+        kps.append(np.zeros(K, np.int32))
+        return FieldSpec(
+            name=name,
+            modulus=modulus,
+            p=jnp.asarray(int_to_limbs(modulus)),
+            nprime=jnp.asarray(int_to_limbs(nprime)),
+            r2=jnp.asarray(int_to_limbs(r2)),
+            one=jnp.asarray(int_to_limbs(1)),
+            one_mont=jnp.asarray(int_to_limbs(R % modulus)),
+            kp=jnp.asarray(np.stack(kps)),
+            mp128=jnp.asarray(int_to_limbs(128 * modulus)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Core limb ops (device; batched over leading axes)
+# ---------------------------------------------------------------------------
+
+def carry2(x: jnp.ndarray) -> jnp.ndarray:
+    """Two local carry passes; output limbs satisfy |limb| < 2**12.
+
+    Valid for column values |v| < 2**30. The top limb is left unmasked so
+    no carry is ever dropped (dropping a negative top carry would add R to
+    the value); for |value| < 2**262 the masked passes keep |top limb|
+    within a few units, preserving the lazy bound.
+    """
+    for _ in range(2):
+        lo = jnp.bitwise_and(x, MASK)
+        lo = lo.at[..., -1].set(x[..., -1])
+        hi = jnp.right_shift(x, B)
+        x = lo + jnp.pad(hi[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+    return x
+
+
+def sb_mul_full(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook product columns: (..., K) x (..., K) -> (..., 2K-1)."""
+    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    out = jnp.zeros(shape + (2 * K - 1,), jnp.int32)
+    for i in range(K):
+        out = out.at[..., i:i + K].add(a[..., i:i + 1] * b)
+    return out
+
+
+def sb_mul_low(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Low K columns of the schoolbook product (i.e. a*b mod-ish R)."""
+    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    out = jnp.zeros(shape + (K,), jnp.int32)
+    for i in range(K):
+        out = out.at[..., i:].add(a[..., i:i + 1] * b[..., :K - i])
+    return out
+
+
+def carry_mod_r(x: jnp.ndarray) -> jnp.ndarray:
+    """carry2 over exactly K limbs, dropping carries past limb K-1 (mod R)."""
+    for _ in range(2):
+        lo = jnp.bitwise_and(x, MASK)
+        hi = jnp.right_shift(x, B)
+        x = lo + jnp.pad(hi[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+    return x
+
+
+def _exact_low_carry(s: jnp.ndarray) -> jnp.ndarray:
+    """Exact carry out of the low K limbs of s (which are ≡ 0 mod R)."""
+    c = jnp.zeros(s.shape[:-1], jnp.int32)
+    for i in range(K):
+        c = jnp.right_shift(s[..., i] + c, B)
+    return c
+
+
+def mont_mul(a: jnp.ndarray, b: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    """Montgomery product a*b*R^-1 mod p (lazy signed limbs in, out)."""
+    t = carry2(sb_mul_full(a, b))                      # (..., 2K-1)
+    m = carry_mod_r(sb_mul_low(t[..., :K], spec.nprime))
+    s = t + sb_mul_full(m, spec.p)                     # low K limbs ≡ 0 mod R
+    c = _exact_low_carry(s)
+    hi = s[..., K:]                                    # (..., K-1)
+    hi = jnp.concatenate(
+        [ (hi[..., :1] + c[..., None]),
+          hi[..., 1:],
+          jnp.zeros(hi.shape[:-1] + (1,), jnp.int32) ], axis=-1)  # (..., K)
+    return carry2(hi)
+
+
+def mont_sqr(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    return mont_mul(a, a, spec)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return carry2(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return carry2(a - b)
+
+
+def to_mont(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    return mont_mul(a, spec.r2, spec)
+
+
+def from_mont(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    return mont_mul(a, spec.one, spec)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small non-negative python int (k < 2**16)."""
+    return carry2(a * jnp.int32(k))
+
+
+def _full_carry_nonneg(x: jnp.ndarray) -> jnp.ndarray:
+    """Full sequential carry; input value must be non-negative and < R."""
+    c = jnp.zeros(x.shape[:-1], jnp.int32)
+    outs = []
+    for i in range(K):
+        t = x[..., i] + c
+        outs.append(jnp.bitwise_and(t, MASK))
+        c = jnp.right_shift(t, B)
+    return jnp.stack(outs, axis=-1)
+
+
+def _geq_sub(v: jnp.ndarray, kp: jnp.ndarray) -> jnp.ndarray:
+    """If canonical v >= canonical kp: v - kp (canonical), else v."""
+    d = v - kp
+    borrow = jnp.zeros(d.shape[:-1], jnp.int32)
+    outs = []
+    for i in range(K):
+        t = d[..., i] + borrow
+        outs.append(jnp.bitwise_and(t, MASK))
+        borrow = jnp.right_shift(t, B)   # 0 or -1
+    sub_ok = (borrow >= 0)[..., None]
+    return jnp.where(sub_ok, jnp.stack(outs, axis=-1), v)
+
+
+def canonical(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    """Reduce lazy signed limbs (|value| < 2**262) to canonical [0, p).
+
+    Adds 128p to lift the value into [0, 2**264+), full-carries, then
+    binary conditional subtraction of 128p..p.
+    """
+    v = _full_carry_nonneg(a + spec.mp128)
+    for i in range(8):
+        v = _geq_sub(v, spec.kp[i])
+    return v
+
+
+def eq_zero(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    """Is lazy signed value ≡ 0 (mod p)?  (..., K) -> (...) bool."""
+    c = canonical(a, spec)
+    return jnp.all(c == 0, axis=-1)
+
+
+def eq_canonical(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Equality of two canonical limb arrays."""
+    return jnp.all(a == b, axis=-1)
+
+
+def pow_static(a_mont: jnp.ndarray, exponent: int, spec: FieldSpec) -> jnp.ndarray:
+    """a^exponent in the Montgomery domain, static python-int exponent.
+
+    Left-to-right square-and-multiply as a lax.scan over the (static) bit
+    string, so the traced graph is one squaring + one multiply.
+    """
+    nbits = max(exponent.bit_length(), 1)
+    bits = jnp.asarray(
+        np.array([(exponent >> (nbits - 1 - i)) & 1 for i in range(nbits)],
+                 np.bool_))
+    acc0 = jnp.broadcast_to(spec.one_mont, a_mont.shape).astype(jnp.int32)
+
+    def body(acc, bit):
+        acc = mont_sqr(acc, spec)
+        withmul = mont_mul(acc, a_mont, spec)
+        acc = jnp.where(bit, withmul, acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(body, acc0, bits)
+    return acc
+
+
+def inv_mont(a_mont: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
+    """Modular inverse in Montgomery domain via Fermat (p must be prime)."""
+    return pow_static(a_mont, spec.modulus - 2, spec)
+
+
+def bits_le(canon: jnp.ndarray, nbits: int = 256) -> jnp.ndarray:
+    """Canonical limbs -> (..., nbits) int32 bit array, LSB first."""
+    limb_idx = np.arange(nbits) // B
+    bit_idx = np.arange(nbits) % B
+    limbs = canon[..., limb_idx]
+    return jnp.right_shift(limbs, jnp.asarray(bit_idx, jnp.int32)) & 1
