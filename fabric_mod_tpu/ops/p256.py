@@ -1,0 +1,264 @@
+"""Batched ECDSA-P256 verification on TPU.
+
+The TPU-native replacement for the reference's per-signature software
+verify (reference: bccsp/sw/ecdsa.go:41-57 ``verifyECDSA`` and the
+dispatch in bccsp/sw/impl.go:247): instead of one goroutine per
+signature behind a semaphore (core/committer/txvalidator/v20/
+validator.go:194-239), the whole block's (digest, r, s, pubkey) tuples
+become device arrays and one jitted program verifies them all.
+
+Point arithmetic uses the Renes-Costello-Batina *complete* projective
+addition formulas for a=-3 short Weierstrass curves (eprint 2015/1060,
+algorithm 4).  Complete formulas are the TPU-idiomatic choice: they are
+branch-free — identity, doubling, and inverse cases all fall out of the
+same straight-line code — so a batch never diverges and XLA sees one
+fused SIMD program.  Doubling is ``add(P, P)`` (valid by completeness);
+a dedicated doubling routine is a later optimisation.
+
+Scalar multiplication u1*G + u2*Q is one interleaved (Shamir) ladder:
+256 iterations of double + table-select-add where the 4-entry table
+[inf, G, Q, G+Q] is selected per lane by the current bit pair.  The
+final comparison avoids an inversion: accept iff X == (r + k*n)*Z
+(mod p) for k in {0, 1} (with r + k*n < p), Z != 0.
+
+All field values live in the Montgomery domain of ops/limbs.py
+(25 x 11-bit signed lazy limbs).  Everything here is shape-static and
+scan-based, so the program jits once per batch size.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fabric_mod_tpu.ops import limbs
+from fabric_mod_tpu.ops.limbs import (
+    FieldSpec, K, add, sub, mont_mul, mont_sqr, to_mont, eq_zero,
+    mul_small, canonical, bits_le, inv_mont, be_bytes_to_limbs,
+)
+
+# --- Curve constants (NIST P-256 / secp256r1) ------------------------------
+P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+B = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
+GX = 0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296
+GY = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5
+
+
+@functools.lru_cache(maxsize=None)
+def _consts():
+    """Device constants: field specs and Montgomery-domain curve params."""
+    fp = FieldSpec.make("p256.p", P)
+    fn = FieldSpec.make("p256.n", N)
+    R = 1 << limbs.RBITS
+    b_m = jnp.asarray(limbs.int_to_limbs((B * R) % P))
+    gx_m = jnp.asarray(limbs.int_to_limbs((GX * R) % P))
+    gy_m = jnp.asarray(limbs.int_to_limbs((GY * R) % P))
+    return fp, fn, b_m, gx_m, gy_m
+
+
+# --- Complete projective point addition (RCB alg. 4, a = -3) ---------------
+
+def point_add(p1, p2, fp: FieldSpec, b_m: jnp.ndarray):
+    """Complete addition of projective points (X:Y:Z), Montgomery domain.
+
+    Valid for ALL inputs on the (prime-order) curve, including P == Q,
+    P == -Q, and either operand at infinity (0:1:0).  Batched over
+    leading axes.  12 muls + 2 muls-by-b; every add/sub re-normalises
+    limbs so lazy value bounds stay far inside limbs.py's 2**262 domain.
+    """
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    t0 = mont_mul(X1, X2, fp)
+    t1 = mont_mul(Y1, Y2, fp)
+    t2 = mont_mul(Z1, Z2, fp)
+    t3 = add(X1, Y1)
+    t4 = add(X2, Y2)
+    t3 = mont_mul(t3, t4, fp)
+    t4 = add(t0, t1)
+    t3 = sub(t3, t4)
+    t4 = add(Y1, Z1)
+    X3 = add(Y2, Z2)
+    t4 = mont_mul(t4, X3, fp)
+    X3 = add(t1, t2)
+    t4 = sub(t4, X3)
+    X3 = add(X1, Z1)
+    Y3 = add(X2, Z2)
+    X3 = mont_mul(X3, Y3, fp)
+    Y3 = add(t0, t2)
+    Y3 = sub(X3, Y3)
+    Z3 = mont_mul(b_m, t2, fp)
+    X3 = sub(Y3, Z3)
+    Z3 = add(X3, X3)
+    X3 = add(X3, Z3)
+    Z3 = sub(t1, X3)
+    X3 = add(t1, X3)
+    Y3 = mont_mul(b_m, Y3, fp)
+    t1 = add(t2, t2)
+    t2 = add(t1, t2)
+    Y3 = sub(Y3, t2)
+    Y3 = sub(Y3, t0)
+    t1 = add(Y3, Y3)
+    Y3 = add(t1, Y3)
+    t1 = add(t0, t0)
+    t0 = add(t1, t0)
+    t0 = sub(t0, t2)
+    t1 = mont_mul(t4, Y3, fp)
+    t2 = mont_mul(t0, Y3, fp)
+    Y3 = mont_mul(X3, Z3, fp)
+    Y3 = add(Y3, t2)
+    X3 = mont_mul(t3, X3, fp)
+    X3 = sub(X3, t1)
+    Z3 = mont_mul(t4, Z3, fp)
+    t1 = mont_mul(t3, t0, fp)
+    Z3 = add(Z3, t1)
+    return (X3, Y3, Z3)
+
+
+def point_double(p, fp: FieldSpec, b_m: jnp.ndarray):
+    return point_add(p, p, fp, b_m)
+
+
+def infinity(shape_prefix) -> tuple:
+    """The projective identity (0 : 1 : 0) in Montgomery domain."""
+    fp, _, _, _, _ = _consts()
+    zero = jnp.zeros(shape_prefix + (K,), jnp.int32)
+    one = jnp.broadcast_to(fp.one_mont, shape_prefix + (K,)).astype(jnp.int32)
+    return (zero, one, zero)
+
+
+def on_curve(xm: jnp.ndarray, ym: jnp.ndarray) -> jnp.ndarray:
+    """y^2 == x^3 - 3x + b (mod p) for Montgomery-domain affine coords."""
+    fp, _, b_m, _, _ = _consts()
+    y2 = mont_sqr(ym, fp)
+    x2 = mont_sqr(xm, fp)
+    x3 = mont_mul(x2, xm, fp)
+    rhs = add(sub(x3, mul_small(xm, 3)), b_m)
+    return eq_zero(sub(y2, rhs), fp)
+
+
+# --- The jitted verify core ------------------------------------------------
+
+@jax.jit
+def verify_core(e: jnp.ndarray, r: jnp.ndarray, s: jnp.ndarray,
+                qx: jnp.ndarray, qy: jnp.ndarray,
+                rn_lt_p: jnp.ndarray) -> jnp.ndarray:
+    """Batched ECDSA-P256 verify on raw limb arrays.
+
+    Args:
+      e, r, s: (batch, K) canonical limbs — digest (as 256-bit int), and
+        signature scalars already range-checked to [1, n-1] on host.
+      qx, qy: (batch, K) canonical limbs of the affine public key,
+        host-checked to be < p.
+      rn_lt_p: (batch,) bool — whether r + n < p (precomputed on host;
+        python-int compare, constant-bound).
+    Returns:
+      (batch,) bool — signature valid AND key on curve.
+    """
+    fp, fn, b_m, gx_m, gy_m = _consts()
+    batch = e.shape[:-1]
+
+    # Key checks: on curve, not the identity encoding (0, 0).
+    qx_m = to_mont(qx, fp)
+    qy_m = to_mont(qy, fp)
+    key_ok = on_curve(qx_m, qy_m)
+    key_ok &= ~(eq_zero(qx, fp) & eq_zero(qy, fp))
+
+    # Scalars mod n: w = s^-1, u1 = e*w, u2 = r*w.  mont_mul of a *plain*
+    # value by a Montgomery-domain one yields a plain product directly.
+    s_mn = to_mont(s, fn)
+    w_mn = inv_mont(s_mn, fn)
+    u1 = canonical(mont_mul(e, w_mn, fn), fn)
+    u2 = canonical(mont_mul(r, w_mn, fn), fn)
+    u1_bits = bits_le(u1)          # (batch, 256) LSB first
+    u2_bits = bits_le(u2)
+
+    # Table [inf, G, Q, G+Q] (projective, Montgomery domain).
+    inf = infinity(batch)
+    g = (jnp.broadcast_to(gx_m, batch + (K,)).astype(jnp.int32),
+         jnp.broadcast_to(gy_m, batch + (K,)).astype(jnp.int32),
+         jnp.broadcast_to(fp.one_mont, batch + (K,)).astype(jnp.int32))
+    q = (qx_m, qy_m, g[2])
+    gq = point_add(g, q, fp, b_m)
+    table = tuple(
+        jnp.stack([inf[c], g[c], q[c], gq[c]], axis=-2)      # (batch, 4, K)
+        for c in range(3))
+
+    # Shamir ladder, MSB -> LSB.
+    idx_bits = jnp.stack([u1_bits, u2_bits], axis=-1)        # (batch, 256, 2)
+    sel_seq = jnp.moveaxis(idx_bits[..., ::-1, :], -2, 0)    # (256, batch, 2)
+
+    def step(acc, bits2):
+        acc = point_double(acc, fp, b_m)
+        idx = bits2[..., 0] + 2 * bits2[..., 1]              # (batch,)
+        onehot = jax.nn.one_hot(idx, 4, dtype=jnp.int32)     # (batch, 4)
+        t = tuple(jnp.einsum("...i,...ik->...k", onehot, table[c])
+                  for c in range(3))
+        acc = point_add(acc, t, fp, b_m)
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, infinity(batch), sel_seq)
+    X, Z = acc[0], acc[2]
+
+    # Accept iff Z != 0 and X == r'*Z for r' in {r, r+n} (r' < p).
+    not_inf = ~eq_zero(Z, fp)
+    r_m = to_mont(r, fp)
+    ok_r = eq_zero(sub(X, mont_mul(r_m, Z, fp)), fp)
+    rn = add(r, jnp.broadcast_to(fn.p, r.shape).astype(jnp.int32))
+    rn_m = to_mont(rn, fp)
+    ok_rn = eq_zero(sub(X, mont_mul(rn_m, Z, fp)), fp) & rn_lt_p
+    return key_ok & not_inf & (ok_r | ok_rn)
+
+
+# --- Host wrapper ----------------------------------------------------------
+
+_N_BYTES = N.to_bytes(32, "big")
+_P_BYTES = P.to_bytes(32, "big")
+_P_MINUS_N_BYTES = (P - N).to_bytes(32, "big")
+
+
+def _lt_bytes(a: np.ndarray, b_: bytes) -> np.ndarray:
+    """Lexicographic a < b over (..., 32) big-endian byte arrays."""
+    bb = np.frombuffer(b_, np.uint8)
+    diff = a.astype(np.int16) - bb.astype(np.int16)
+    nz = diff != 0
+    first = np.argmax(nz, axis=-1)
+    any_nz = nz.any(axis=-1)
+    firstval = np.take_along_axis(diff, first[..., None], axis=-1)[..., 0]
+    return np.where(any_nz, firstval < 0, False)
+
+
+def batch_verify(digests: np.ndarray, r_bytes: np.ndarray,
+                 s_bytes: np.ndarray, qx_bytes: np.ndarray,
+                 qy_bytes: np.ndarray) -> np.ndarray:
+    """Verify a batch of ECDSA-P256 signatures over 32-byte digests.
+
+    All args are (batch, 32) uint8 big-endian.  Returns (batch,) bool.
+    Host does only range checks + byte->limb marshalling; all field math
+    runs in one jitted device program.
+    """
+    digests = np.asarray(digests, np.uint8)
+    r_bytes = np.asarray(r_bytes, np.uint8)
+    s_bytes = np.asarray(s_bytes, np.uint8)
+    qx_bytes = np.asarray(qx_bytes, np.uint8)
+    qy_bytes = np.asarray(qy_bytes, np.uint8)
+
+    nonzero_r = r_bytes.any(axis=-1)
+    nonzero_s = s_bytes.any(axis=-1)
+    range_ok = (nonzero_r & nonzero_s
+                & _lt_bytes(r_bytes, _N_BYTES) & _lt_bytes(s_bytes, _N_BYTES)
+                & _lt_bytes(qx_bytes, _P_BYTES)
+                & _lt_bytes(qy_bytes, _P_BYTES))
+    rn_lt_p = _lt_bytes(r_bytes, _P_MINUS_N_BYTES)
+
+    ok = verify_core(
+        jnp.asarray(be_bytes_to_limbs(digests)),
+        jnp.asarray(be_bytes_to_limbs(r_bytes)),
+        jnp.asarray(be_bytes_to_limbs(s_bytes)),
+        jnp.asarray(be_bytes_to_limbs(qx_bytes)),
+        jnp.asarray(be_bytes_to_limbs(qy_bytes)),
+        jnp.asarray(rn_lt_p),
+    )
+    return np.asarray(ok) & range_ok

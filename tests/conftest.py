@@ -6,12 +6,21 @@ Env vars must be set before jax is first imported anywhere.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the session environment pins JAX_PLATFORMS to the real TPU
+# tunnel (a sitecustomize registers the plugin at interpreter startup),
+# but unit tests must run on the virtual 8-device CPU mesh.  Both the
+# env var and the config update are needed: the env var alone loses if
+# the plugin was already registered.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
