@@ -1,0 +1,50 @@
+"""Provider factory (reference: bccsp/factory/factory.go:32-64).
+
+Selects sw vs tpu provider from config and keeps a process-global
+default, mirroring `factory.GetDefault`.  The tpu provider is the
+"pkcs11 slot" of this framework: same selection seam, different
+device (reference: bccsp/factory/swfactory.go, sampleconfig/
+core.yaml:297-310 BCCSP section).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from fabric_mod_tpu.bccsp.api import BCCSP
+from fabric_mod_tpu.bccsp.sw import SwCSP
+
+_default: Optional[BCCSP] = None
+_lock = threading.Lock()
+
+
+def new_provider(config: Optional[dict] = None) -> BCCSP:
+    """config = {"default": "SW"|"TPU", "keystore": path|None}.
+
+    The tpu module is imported lazily: selecting the SW provider must
+    not drag in jax or mutate device/compile-cache config.
+    """
+    config = config or {}
+    kind = config.get("default", "SW").upper()
+    ks = config.get("keystore")
+    if kind == "SW":
+        return SwCSP(ks)
+    if kind == "TPU":
+        from fabric_mod_tpu.bccsp.tpu import TpuCSP
+        return TpuCSP(ks)
+    raise ValueError(f"unknown BCCSP provider {kind!r}")
+
+
+def get_default() -> BCCSP:
+    global _default
+    with _lock:
+        if _default is None:
+            _default = SwCSP()
+        return _default
+
+
+def init_factories(config: Optional[dict] = None) -> BCCSP:
+    global _default
+    with _lock:
+        _default = new_provider(config)
+        return _default

@@ -1,0 +1,226 @@
+"""Software crypto provider — the CPU reference implementation.
+
+The analog of the reference's default provider (reference: bccsp/sw/
+impl.go:247 dispatch, bccsp/sw/ecdsa.go:27-57 sign/verify with the
+low-S rule, bccsp/sw/fileks.go keystore): pure host-side crypto via
+the `cryptography` package (OpenSSL).  Every layer above is testable
+against this provider with no TPU, mirroring how the reference's unit
+suites run on bccsp/sw; it is also the baseline the device provider's
+benchmark compares against.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional, Sequence
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed, decode_dss_signature, encode_dss_signature)
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+from cryptography.hazmat.primitives.padding import PKCS7
+
+from fabric_mod_tpu.bccsp.api import BCCSP, Key, VerifyItem
+
+_CURVES = {"P256": ec.SECP256R1, "P384": ec.SECP384R1}
+_ORDERS = {
+    "P256": 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551,
+    "P384": int("FFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFC7634D81F4372DDF"
+                "581A0DB248B0A77AECEC196ACCC52973", 16),
+}
+_HASHES = {"SHA256": hashlib.sha256, "SHA384": hashlib.sha384,
+           "SHA3_256": hashlib.sha3_256, "SHA3_384": hashlib.sha3_384}
+
+
+def point_bytes(pub: ec.EllipticCurvePublicKey) -> bytes:
+    """Uncompressed point encoding 0x04‖x‖y (SKI input, like the ref)."""
+    return pub.public_bytes(serialization.Encoding.X962,
+                            serialization.PublicFormat.UncompressedPoint)
+
+
+def ski_of(pub: ec.EllipticCurvePublicKey) -> bytes:
+    return hashlib.sha256(point_bytes(pub)).digest()
+
+
+def normalize_low_s(der_sig: bytes, curve: str = "P256") -> bytes:
+    """Rewrite s -> n - s when s > n/2 (the reference's low-S rule)."""
+    n = _ORDERS[curve]
+    r, s = decode_dss_signature(der_sig)
+    if s > n // 2:
+        s = n - s
+    return encode_dss_signature(r, s)
+
+
+def is_low_s(der_sig: bytes, curve: str = "P256") -> bool:
+    _, s = decode_dss_signature(der_sig)
+    return s <= _ORDERS[curve] // 2
+
+
+class EcdsaKey(Key):
+    def __init__(self, priv: Optional[ec.EllipticCurvePrivateKey],
+                 pub: ec.EllipticCurvePublicKey, curve: str):
+        self._priv, self._pub, self.curve = priv, pub, curve
+
+    def ski(self) -> bytes:
+        return ski_of(self._pub)
+
+    def private(self) -> bool:
+        return self._priv is not None
+
+    def public_key(self) -> "EcdsaKey":
+        return EcdsaKey(None, self._pub, self.curve)
+
+    def bytes_(self) -> bytes:
+        return point_bytes(self._pub)
+
+    def public_xy(self) -> bytes:
+        return point_bytes(self._pub)[1:]
+
+
+class AesKey(Key):
+    def __init__(self, raw: bytes):
+        self._raw = raw
+
+    def ski(self) -> bytes:
+        return hashlib.sha256(self._raw).digest()
+
+    def private(self) -> bool:
+        return True
+
+    def public_key(self) -> Key:
+        raise ValueError("symmetric key has no public half")
+
+    def bytes_(self) -> bytes:
+        return self._raw
+
+
+class FileKeyStore:
+    """PEM-file keystore by hex SKI (reference: bccsp/sw/fileks.go)."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        if path:
+            os.makedirs(path, exist_ok=True)
+
+    def store(self, key: EcdsaKey) -> None:
+        if not self.path:
+            return
+        if key.private():
+            pem = key._priv.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption())
+            name = key.ski().hex() + "_sk.pem"
+        else:
+            pem = key._pub.public_bytes(
+                serialization.Encoding.PEM,
+                serialization.PublicFormat.SubjectPublicKeyInfo)
+            name = key.ski().hex() + "_pk.pem"
+        with open(os.path.join(self.path, name), "wb") as f:
+            f.write(pem)
+
+    def load(self, ski: bytes) -> Optional[EcdsaKey]:
+        if not self.path:
+            return None
+        for suffix in ("_sk.pem", "_pk.pem"):
+            p = os.path.join(self.path, ski.hex() + suffix)
+            if os.path.exists(p):
+                with open(p, "rb") as f:
+                    data = f.read()
+                if suffix == "_sk.pem":
+                    priv = serialization.load_pem_private_key(data, None)
+                    curve = "P256" if priv.curve.key_size == 256 else "P384"
+                    return EcdsaKey(priv, priv.public_key(), curve)
+                pub = serialization.load_pem_public_key(data)
+                curve = "P256" if pub.curve.key_size == 256 else "P384"
+                return EcdsaKey(None, pub, curve)
+        return None
+
+
+class SwCSP(BCCSP):
+    """Host software provider (reference: bccsp/sw)."""
+
+    def __init__(self, keystore_path: Optional[str] = None):
+        self._ks = FileKeyStore(keystore_path)
+        self._mem: dict[bytes, Key] = {}
+
+    # -- keys --
+    def key_gen(self, algorithm: str = "P256", ephemeral: bool = True) -> Key:
+        if algorithm in _CURVES:
+            priv = ec.generate_private_key(_CURVES[algorithm]())
+            key = EcdsaKey(priv, priv.public_key(), algorithm)
+        elif algorithm.startswith("AES"):
+            key = AesKey(os.urandom(int(algorithm[3:]) // 8))
+        else:
+            raise ValueError(f"unknown algorithm {algorithm}")
+        self._mem[key.ski()] = key
+        if not ephemeral and isinstance(key, EcdsaKey):
+            self._ks.store(key)
+        return key
+
+    def key_import(self, raw: bytes, kind: str) -> Key:
+        if kind == "P256-pub":
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(
+                ec.SECP256R1(), raw)
+            return EcdsaKey(None, pub, "P256")
+        if kind == "P384-pub":
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(
+                ec.SECP384R1(), raw)
+            return EcdsaKey(None, pub, "P384")
+        if kind == "pem-priv":
+            priv = serialization.load_pem_private_key(raw, None)
+            curve = "P256" if priv.curve.key_size == 256 else "P384"
+            key = EcdsaKey(priv, priv.public_key(), curve)
+            self._mem[key.ski()] = key
+            return key
+        if kind == "pem-pub" or kind == "x509-pub":
+            pub = serialization.load_pem_public_key(raw)
+            curve = "P256" if pub.curve.key_size == 256 else "P384"
+            return EcdsaKey(None, pub, curve)
+        if kind.startswith("AES"):
+            key = AesKey(raw)
+            self._mem[key.ski()] = key
+            return key
+        raise ValueError(f"unknown import kind {kind}")
+
+    def get_key(self, ski: bytes) -> Optional[Key]:
+        return self._mem.get(ski) or self._ks.load(ski)
+
+    # -- hash --
+    def hash(self, msg: bytes, algorithm: str = "SHA256") -> bytes:
+        return _HASHES[algorithm](msg).digest()
+
+    # -- sign/verify --
+    def sign(self, key: EcdsaKey, digest: bytes) -> bytes:
+        if not key.private():
+            raise ValueError("signing needs a private key")
+        halg = hashes.SHA256() if key.curve == "P256" else hashes.SHA384()
+        der = key._priv.sign(digest, ec.ECDSA(Prehashed(halg)))
+        return normalize_low_s(der, key.curve)
+
+    def verify(self, key: EcdsaKey, signature: bytes, digest: bytes) -> bool:
+        try:
+            if not is_low_s(signature, key.curve):
+                return False
+            halg = hashes.SHA256() if key.curve == "P256" else hashes.SHA384()
+            key._pub.verify(signature, digest, ec.ECDSA(Prehashed(halg)))
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+    # -- symmetric (AES-CBC-PKCS7, reference: bccsp/sw/aes.go) --
+    def encrypt(self, key: AesKey, plaintext: bytes) -> bytes:
+        iv = os.urandom(16)
+        padder = PKCS7(128).padder()
+        padded = padder.update(plaintext) + padder.finalize()
+        enc = Cipher(algorithms.AES(key.bytes_()), modes.CBC(iv)).encryptor()
+        return iv + enc.update(padded) + enc.finalize()
+
+    def decrypt(self, key: AesKey, ciphertext: bytes) -> bytes:
+        iv, body = ciphertext[:16], ciphertext[16:]
+        dec = Cipher(algorithms.AES(key.bytes_()), modes.CBC(iv)).decryptor()
+        padded = dec.update(body) + dec.finalize()
+        unpadder = PKCS7(128).unpadder()
+        return unpadder.update(padded) + unpadder.finalize()

@@ -1,0 +1,106 @@
+"""BCCSP provider tests: sw/tpu agreement, keystore, batching service."""
+import threading
+
+import numpy as np
+import pytest
+
+from fabric_mod_tpu.bccsp import factory, sw, tpu
+from fabric_mod_tpu.bccsp.api import VerifyItem
+
+
+@pytest.fixture(scope="module")
+def swcsp():
+    return sw.SwCSP()
+
+
+def test_sign_verify_roundtrip(swcsp):
+    key = swcsp.key_gen("P256")
+    digest = swcsp.hash(b"hello fabric")
+    sig = swcsp.sign(key, digest)
+    assert swcsp.verify(key.public_key(), sig, digest)
+    assert not swcsp.verify(key.public_key(), sig, swcsp.hash(b"other"))
+    assert sw.is_low_s(sig)  # provider always emits low-S
+
+
+def test_high_s_rejected(swcsp):
+    key = swcsp.key_gen("P256")
+    digest = swcsp.hash(b"msg")
+    r, s = sw.decode_dss_signature(swcsp.sign(key, digest))
+    high = sw.encode_dss_signature(r, sw._ORDERS["P256"] - s)
+    assert not swcsp.verify(key.public_key(), high, digest)
+
+
+def test_p384_roundtrip(swcsp):
+    key = swcsp.key_gen("P384")
+    digest = swcsp.hash(b"msg", "SHA384")
+    sig = swcsp.sign(key, digest)
+    assert swcsp.verify(key.public_key(), sig, digest)
+
+
+def test_keystore_roundtrip(tmp_path):
+    csp = sw.SwCSP(str(tmp_path))
+    key = csp.key_gen("P256", ephemeral=False)
+    fresh = sw.SwCSP(str(tmp_path))
+    loaded = fresh.get_key(key.ski())
+    assert loaded is not None and loaded.private()
+    digest = fresh.hash(b"stored key works")
+    assert fresh.verify(loaded.public_key(), fresh.sign(loaded, digest), digest)
+
+
+def test_aes_roundtrip(swcsp):
+    key = swcsp.key_gen("AES256")
+    ct = swcsp.encrypt(key, b"secret payload")
+    assert swcsp.decrypt(key, ct) == b"secret payload"
+    assert ct[16:] != b"secret payload"
+
+
+def _make_items(csp, n, tamper=()):
+    items = []
+    for i in range(n):
+        key = csp.key_gen("P256")
+        digest = csp.hash(f"message {i}".encode())
+        sig = csp.sign(key, digest)
+        if i in tamper:
+            digest = csp.hash(b"TAMPERED")
+        items.append(VerifyItem(digest, sig, key.public_xy()))
+    return items
+
+
+def test_tpu_provider_matches_sw(swcsp):
+    csp = tpu.TpuCSP()
+    items = _make_items(swcsp, 6, tamper={1, 4})
+    got = csp.verify_batch(items)
+    expect = swcsp.verify_batch(items)
+    assert got == expect == [True, False, True, True, False, True]
+
+
+def test_tpu_provider_rejects_garbage_der(swcsp):
+    csp = tpu.TpuCSP()
+    good = _make_items(swcsp, 1)[0]
+    bad = VerifyItem(good.digest, b"\x30\x02\x01\x01", good.public_xy)
+    assert csp.verify_batch([good, bad]) == [True, False]
+
+
+def test_batching_service_concurrent(swcsp):
+    service = tpu.BatchingVerifyService(
+        verifier=tpu.FakeBatchVerifier(swcsp), deadline_s=0.01)
+    items = _make_items(swcsp, 8, tamper={3})
+    results = [None] * len(items)
+
+    def worker(i):
+        results[i] = service.verify(items[i])
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(items))]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    service.close()
+    assert results == [True, True, True, False, True, True, True, True]
+
+
+def test_factory_selection(tmp_path):
+    assert isinstance(factory.new_provider({"default": "SW"}), sw.SwCSP)
+    assert isinstance(factory.new_provider({"default": "TPU"}), tpu.TpuCSP)
+    with pytest.raises(ValueError):
+        factory.new_provider({"default": "HSM"})
+    assert factory.get_default() is factory.get_default()

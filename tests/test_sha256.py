@@ -1,0 +1,26 @@
+"""Batch SHA-256 vs hashlib, including padding edge lengths."""
+import hashlib
+
+import numpy as np
+
+from fabric_mod_tpu.ops import sha256
+
+
+def test_padding_edge_lengths():
+    lens = [0, 1, 54, 55, 56, 57, 63, 64, 65, 119, 120, 128, 1000]
+    msgs = [bytes(range(256))[:n] if n <= 256 else b"x" * n for n in lens]
+    msgs = [(str(i).encode() + m)[: lens[i]] for i, m in enumerate(msgs)]
+    got = sha256.sha256_many(msgs)
+    for i, m in enumerate(msgs):
+        assert bytes(got[i]) == hashlib.sha256(m).digest(), f"len={lens[i]}"
+
+
+def test_random_batch(rng):
+    msgs = [rng.randbytes(rng.randrange(0, 500)) for _ in range(64)]
+    got = sha256.sha256_many(msgs)
+    for i, m in enumerate(msgs):
+        assert bytes(got[i]) == hashlib.sha256(m).digest()
+
+
+def test_empty_batch():
+    assert sha256.sha256_many([]).shape == (0, 32)
