@@ -34,6 +34,16 @@ _HASHES = {"SHA256": hashlib.sha256, "SHA384": hashlib.sha384,
            "SHA3_256": hashlib.sha3_256, "SHA3_384": hashlib.sha3_384}
 
 
+def _curve_name(key) -> str:
+    """Strict curve classification; unsupported key types are errors."""
+    curve = getattr(key, "curve", None)
+    if isinstance(curve, ec.SECP256R1):
+        return "P256"
+    if isinstance(curve, ec.SECP384R1):
+        return "P384"
+    raise ValueError(f"unsupported key/curve: {type(curve).__name__}")
+
+
 def point_bytes(pub: ec.EllipticCurvePublicKey) -> bytes:
     """Uncompressed point encoding 0x04‖x‖y (SKI input, like the ref)."""
     return pub.public_bytes(serialization.Encoding.X962,
@@ -131,11 +141,10 @@ class FileKeyStore:
                     data = f.read()
                 if suffix == "_sk.pem":
                     priv = serialization.load_pem_private_key(data, None)
-                    curve = "P256" if priv.curve.key_size == 256 else "P384"
-                    return EcdsaKey(priv, priv.public_key(), curve)
+                    return EcdsaKey(priv, priv.public_key(),
+                                    _curve_name(priv))
                 pub = serialization.load_pem_public_key(data)
-                curve = "P256" if pub.curve.key_size == 256 else "P384"
-                return EcdsaKey(None, pub, curve)
+                return EcdsaKey(None, pub, _curve_name(pub))
         return None
 
 
@@ -171,14 +180,12 @@ class SwCSP(BCCSP):
             return EcdsaKey(None, pub, "P384")
         if kind == "pem-priv":
             priv = serialization.load_pem_private_key(raw, None)
-            curve = "P256" if priv.curve.key_size == 256 else "P384"
-            key = EcdsaKey(priv, priv.public_key(), curve)
+            key = EcdsaKey(priv, priv.public_key(), _curve_name(priv))
             self._mem[key.ski()] = key
             return key
         if kind == "pem-pub" or kind == "x509-pub":
             pub = serialization.load_pem_public_key(raw)
-            curve = "P256" if pub.curve.key_size == 256 else "P384"
-            return EcdsaKey(None, pub, curve)
+            return EcdsaKey(None, pub, _curve_name(pub))
         if kind.startswith("AES"):
             key = AesKey(raw)
             self._mem[key.ski()] = key

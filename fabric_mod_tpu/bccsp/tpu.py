@@ -62,10 +62,13 @@ _LOW_S_MAX = _P256_N // 2
 
 
 def _bucket(n: int) -> int:
+    """Smallest static bucket holding n; n must be <= max bucket
+    (larger batches are chunked by the caller so the set of compiled
+    program shapes stays fixed)."""
     for b in BUCKETS:
         if n <= b:
             return b
-    return ((n + BUCKETS[-1] - 1) // BUCKETS[-1]) * BUCKETS[-1]
+    raise ValueError(f"batch {n} exceeds max bucket {BUCKETS[-1]}")
 
 
 class TpuVerifier:
@@ -81,6 +84,11 @@ class TpuVerifier:
         n = len(items)
         if n == 0:
             return np.zeros(0, bool)
+        if n > BUCKETS[-1]:
+            # chunk through the fixed buckets — never mint new shapes
+            return np.concatenate([
+                self.verify_many(items[i:i + BUCKETS[-1]])
+                for i in range(0, n, BUCKETS[-1])])
         size = _bucket(n)
         d = np.zeros((size, 32), np.uint8)
         r = np.zeros((size, 32), np.uint8)
@@ -141,6 +149,9 @@ class BatchingVerifyService:
 
     def submit(self, item: VerifyItem) -> Future:
         fut: Future = Future()
+        if self._stop.is_set():
+            fut.set_exception(RuntimeError("verify service is closed"))
+            return fut
         self._q.put((item, fut))
         return fut
 
@@ -152,6 +163,14 @@ class BatchingVerifyService:
         gets a verdict (callers may be blocked on their Futures)."""
         self._stop.set()
         self._worker.join(timeout=30)
+        # A submit may have raced the worker's final drain; fail any
+        # stragglers rather than leaving callers hung.
+        while True:
+            try:
+                _, fut = self._q.get_nowait()
+            except queue.Empty:
+                break
+            fut.set_exception(RuntimeError("verify service is closed"))
 
     def _flush(self, batch) -> None:
         try:

@@ -96,16 +96,22 @@ def limbs_to_be_bytes(a: np.ndarray) -> np.ndarray:
 
 @dataclasses.dataclass(frozen=True)
 class FieldSpec:
-    """Montgomery constants for one odd modulus, as device arrays."""
+    """Montgomery constants for one odd modulus.
+
+    Stored as *numpy* arrays on purpose: the spec is lru_cached and may
+    be first materialized inside a jit trace — caching jnp values there
+    would cache tracers (leak).  numpy constants are trace-neutral and
+    XLA lifts them into the compiled program at each use site.
+    """
     name: str
     modulus: int                 # python int, for host-side math/tests
-    p: jnp.ndarray               # (K,) canonical limbs of modulus
-    nprime: jnp.ndarray          # (K,) canonical limbs of -p^-1 mod R
-    r2: jnp.ndarray              # (K,) R^2 mod p   (to_mont multiplier)
-    one: jnp.ndarray             # (K,) limbs of 1
-    one_mont: jnp.ndarray        # (K,) R mod p     (Montgomery one)
-    kp: jnp.ndarray              # (9, K) canonical limbs of [128p,64p,...,p, 0]
-    mp128: jnp.ndarray           # (K,) canonical limbs of 128p (sign lift)
+    p: np.ndarray                # (K,) canonical limbs of modulus
+    nprime: np.ndarray           # (K,) canonical limbs of -p^-1 mod R
+    r2: np.ndarray               # (K,) R^2 mod p   (to_mont multiplier)
+    one: np.ndarray              # (K,) limbs of 1
+    one_mont: np.ndarray         # (K,) R mod p     (Montgomery one)
+    kp: np.ndarray               # (9, K) canonical limbs of [128p,64p,...,p, 0]
+    mp128: np.ndarray            # (K,) canonical limbs of 128p (sign lift)
 
     @staticmethod
     @functools.lru_cache(maxsize=None)
@@ -118,13 +124,13 @@ class FieldSpec:
         return FieldSpec(
             name=name,
             modulus=modulus,
-            p=jnp.asarray(int_to_limbs(modulus)),
-            nprime=jnp.asarray(int_to_limbs(nprime)),
-            r2=jnp.asarray(int_to_limbs(r2)),
-            one=jnp.asarray(int_to_limbs(1)),
-            one_mont=jnp.asarray(int_to_limbs(R % modulus)),
-            kp=jnp.asarray(np.stack(kps)),
-            mp128=jnp.asarray(int_to_limbs(128 * modulus)),
+            p=int_to_limbs(modulus),
+            nprime=int_to_limbs(nprime),
+            r2=int_to_limbs(r2),
+            one=int_to_limbs(1),
+            one_mont=int_to_limbs(R % modulus),
+            kp=np.stack(kps),
+            mp128=int_to_limbs(128 * modulus),
         )
 
 

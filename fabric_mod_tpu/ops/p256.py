@@ -49,13 +49,17 @@ GY = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5
 
 @functools.lru_cache(maxsize=None)
 def _consts():
-    """Device constants: field specs and Montgomery-domain curve params."""
+    """Field specs and Montgomery-domain curve params.
+
+    numpy (not jnp) on purpose — may be first called under a jit trace,
+    and caching jnp values there would cache tracers.
+    """
     fp = FieldSpec.make("p256.p", P)
     fn = FieldSpec.make("p256.n", N)
     R = 1 << limbs.RBITS
-    b_m = jnp.asarray(limbs.int_to_limbs((B * R) % P))
-    gx_m = jnp.asarray(limbs.int_to_limbs((GX * R) % P))
-    gy_m = jnp.asarray(limbs.int_to_limbs((GY * R) % P))
+    b_m = limbs.int_to_limbs((B * R) % P)
+    gx_m = limbs.int_to_limbs((GX * R) % P)
+    gy_m = limbs.int_to_limbs((GY * R) % P)
     return fp, fn, b_m, gx_m, gy_m
 
 
