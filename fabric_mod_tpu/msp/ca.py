@@ -1,0 +1,84 @@
+"""Certificate authority helpers — crypto material generation.
+
+The library core of the cryptogen-equivalent CLI (reference:
+internal/cryptogen/ca/ca.go, internal/cryptogen/msp/msp.go) and of the
+unit-test fixtures (the reference checks in MSP trees under
+msp/testdata; we generate them on the fly instead).
+"""
+from __future__ import annotations
+
+import datetime
+from typing import Optional
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+
+def _name(cn: str, org: Optional[str] = None, ou: Optional[list] = None):
+    attrs = [x509.NameAttribute(NameOID.COMMON_NAME, cn)]
+    if org:
+        attrs.append(x509.NameAttribute(NameOID.ORGANIZATION_NAME, org))
+    for u in ou or []:
+        attrs.append(x509.NameAttribute(NameOID.ORGANIZATIONAL_UNIT_NAME, u))
+    return x509.Name(attrs)
+
+
+class CA:
+    """A self-signed signing CA that can issue EC P-256 certs."""
+
+    def __init__(self, name: str, org: str = "org",
+                 valid_days: int = 3650):
+        self.key = ec.generate_private_key(ec.SECP256R1())
+        now = datetime.datetime.now(datetime.timezone.utc)
+        subject = _name(name, org)
+        self.cert = (
+            x509.CertificateBuilder()
+            .subject_name(subject).issuer_name(subject)
+            .public_key(self.key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=valid_days))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                           critical=True)
+            .add_extension(x509.KeyUsage(
+                digital_signature=True, key_cert_sign=True, crl_sign=True,
+                content_commitment=False, key_encipherment=False,
+                data_encipherment=False, key_agreement=False,
+                encipher_only=False, decipher_only=False), critical=True)
+            .sign(self.key, hashes.SHA256()))
+
+    def issue(self, cn: str, org: Optional[str] = None,
+              ous: Optional[list] = None, is_ca: bool = False,
+              valid_days: int = 3650, not_after=None,
+              key: Optional[ec.EllipticCurvePrivateKey] = None):
+        """Issue a cert; returns (cert, private_key)."""
+        key = key or ec.generate_private_key(ec.SECP256R1())
+        now = datetime.datetime.now(datetime.timezone.utc)
+        builder = (
+            x509.CertificateBuilder()
+            .subject_name(_name(cn, org, ous))
+            .issuer_name(self.cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(not_after or
+                             now + datetime.timedelta(days=valid_days))
+            .add_extension(x509.BasicConstraints(ca=is_ca, path_length=None),
+                           critical=True))
+        cert = builder.sign(self.key, hashes.SHA256())
+        return cert, key
+
+    def cert_pem(self) -> bytes:
+        return self.cert.public_bytes(serialization.Encoding.PEM)
+
+
+def key_pem(key) -> bytes:
+    return key.private_bytes(serialization.Encoding.PEM,
+                             serialization.PrivateFormat.PKCS8,
+                             serialization.NoEncryption())
+
+
+def cert_pem(cert) -> bytes:
+    return cert.public_bytes(serialization.Encoding.PEM)

@@ -1,0 +1,90 @@
+"""X.509 identities (reference: msp/identities.go).
+
+An Identity wraps a certificate; `verify(msg, sig)` is hash-then-
+BCCSP-verify exactly like the reference (msp/identities.go:169-196),
+which is what lets the TPU batch provider take over every identity
+signature check in the framework.  `verify_item` exposes the same
+check as a VerifyItem so callers can batch instead.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+from cryptography import x509
+from cryptography.hazmat.primitives import serialization
+
+from fabric_mod_tpu.bccsp.api import BCCSP, VerifyItem
+from fabric_mod_tpu.bccsp import sw as swlib
+from fabric_mod_tpu.protos import messages as m
+
+
+class Identity:
+    def __init__(self, mspid: str, cert: x509.Certificate, csp: BCCSP):
+        self.mspid = mspid
+        self.cert = cert
+        self._csp = csp
+        self._key = csp.key_import(
+            cert.public_key().public_bytes(
+                serialization.Encoding.PEM,
+                serialization.PublicFormat.SubjectPublicKeyInfo),
+            "pem-pub")
+
+    # -- serialization --
+    def cert_pem(self) -> bytes:
+        return self.cert.public_bytes(serialization.Encoding.PEM)
+
+    def serialize(self) -> bytes:
+        return m.SerializedIdentity(mspid=self.mspid,
+                                    id_bytes=self.cert_pem()).encode()
+
+    def ski(self) -> bytes:
+        return self._key.ski()
+
+    # -- attributes --
+    def expires_at(self):
+        return self.cert.not_valid_after_utc
+
+    def organizational_units(self) -> list:
+        return [ou.value for ou in self.cert.subject.get_attributes_for_oid(
+            x509.NameOID.ORGANIZATIONAL_UNIT_NAME)]
+
+    def common_name(self) -> str:
+        cns = self.cert.subject.get_attributes_for_oid(x509.NameOID.COMMON_NAME)
+        return cns[0].value if cns else ""
+
+    # -- crypto --
+    def digest_for(self, msg: bytes) -> bytes:
+        alg = "SHA256" if self._key.curve == "P256" else "SHA384"
+        return self._csp.hash(msg, alg)
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        """Hash-then-verify (reference: msp/identities.go:169)."""
+        return self._csp.verify(self._key, sig, self.digest_for(msg))
+
+    def verify_item(self, msg: bytes, sig: bytes) -> Optional[VerifyItem]:
+        """The same check as a batchable work item (P-256 only)."""
+        if self._key.curve != "P256":
+            return None
+        return VerifyItem(self.digest_for(msg), sig, self._key.public_xy())
+
+
+class SigningIdentity(Identity):
+    def __init__(self, mspid: str, cert: x509.Certificate,
+                 private_key_pem: bytes, csp: BCCSP):
+        super().__init__(mspid, cert, csp)
+        self._priv = csp.key_import(private_key_pem, "pem-priv")
+
+    def sign_message(self, msg: bytes) -> bytes:
+        return self._csp.sign(self._priv, self.digest_for(msg))
+
+
+def deserialize_cert(id_bytes: bytes) -> x509.Certificate:
+    if id_bytes.lstrip().startswith(b"-----BEGIN"):
+        return x509.load_pem_x509_certificate(id_bytes)
+    return x509.load_der_x509_certificate(id_bytes)
+
+
+def cert_fingerprint(cert: x509.Certificate) -> bytes:
+    return hashlib.sha256(cert.public_bytes(
+        serialization.Encoding.DER)).digest()

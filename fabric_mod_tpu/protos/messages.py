@@ -1,0 +1,469 @@
+"""Wire message definitions.
+
+Field numbers mirror the reference's proto schema (fabric-protos:
+common/common.proto, common/policies.proto, msp/identities.proto,
+peer/proposal.proto, peer/transaction.proto, peer/chaincode.proto,
+ledger/rwset/*.proto) so the structure is recognizable and a future
+interop shim is mechanical; the implementation is the deterministic
+encoder in wire.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from fabric_mod_tpu.protos.wire import Msg, message
+
+_f = dataclasses.field
+
+
+# --- common/common.proto ---------------------------------------------------
+
+class HeaderType:
+    MESSAGE = 0
+    CONFIG = 1
+    CONFIG_UPDATE = 2
+    ENDORSER_TRANSACTION = 3
+    ORDERER_TRANSACTION = 4
+    DELIVER_SEEK_INFO = 5
+    CHAINCODE_PACKAGE = 6
+
+
+class TxValidationCode:
+    VALID = 0
+    NIL_ENVELOPE = 1
+    BAD_PAYLOAD = 2
+    BAD_COMMON_HEADER = 3
+    BAD_CREATOR_SIGNATURE = 4
+    INVALID_ENDORSER_TRANSACTION = 5
+    INVALID_CONFIG_TRANSACTION = 6
+    UNSUPPORTED_TX_PAYLOAD = 7
+    BAD_PROPOSAL_TXID = 8
+    DUPLICATE_TXID = 9
+    ENDORSEMENT_POLICY_FAILURE = 10
+    MVCC_READ_CONFLICT = 11
+    PHANTOM_READ_CONFLICT = 12
+    UNKNOWN_TX_TYPE = 13
+    TARGET_CHAIN_NOT_FOUND = 14
+    MARSHAL_TX_ERROR = 15
+    NIL_TXACTION = 16
+    EXPIRED_CHAINCODE = 17
+    CHAINCODE_VERSION_CONFLICT = 18
+    BAD_HEADER_EXTENSION = 19
+    BAD_CHANNEL_HEADER = 20
+    BAD_RESPONSE_PAYLOAD = 21
+    BAD_RWSET = 22
+    ILLEGAL_WRITESET = 23
+    INVALID_WRITESET = 24
+    INVALID_CHAINCODE = 25
+    NOT_VALIDATED = 254
+    INVALID_OTHER_REASON = 255
+
+
+@message
+class ChannelHeader(Msg):
+    FIELDS = ((1, "type", "i"), (2, "version", "i"), (3, "timestamp", "u"),
+              (4, "channel_id", "s"), (5, "tx_id", "s"), (6, "epoch", "u"),
+              (7, "extension", "b"), (8, "tls_cert_hash", "b"))
+    type: int = 0
+    version: int = 0
+    timestamp: int = 0          # unix nanos (proto uses Timestamp msg)
+    channel_id: str = ""
+    tx_id: str = ""
+    epoch: int = 0
+    extension: bytes = b""
+    tls_cert_hash: bytes = b""
+
+
+@message
+class SignatureHeader(Msg):
+    FIELDS = ((1, "creator", "b"), (2, "nonce", "b"))
+    creator: bytes = b""
+    nonce: bytes = b""
+
+
+@message
+class Header(Msg):
+    FIELDS = ((1, "channel_header", "b"), (2, "signature_header", "b"))
+    channel_header: bytes = b""
+    signature_header: bytes = b""
+
+
+@message
+class Payload(Msg):
+    FIELDS = ((1, "header", ("m", "Header")), (2, "data", "b"))
+    header: Optional[Header] = None
+    data: bytes = b""
+
+
+@message
+class Envelope(Msg):
+    FIELDS = ((1, "payload", "b"), (2, "signature", "b"))
+    payload: bytes = b""
+    signature: bytes = b""
+
+
+@message
+class BlockHeader(Msg):
+    FIELDS = ((1, "number", "u"), (2, "previous_hash", "b"),
+              (3, "data_hash", "b"))
+    number: int = 0
+    previous_hash: bytes = b""
+    data_hash: bytes = b""
+
+
+@message
+class BlockData(Msg):
+    FIELDS = ((1, "data", ["b"]),)
+    data: List[bytes] = _f(default_factory=list)
+
+
+@message
+class MetadataSignature(Msg):
+    FIELDS = ((1, "signature_header", "b"), (2, "signature", "b"))
+    signature_header: bytes = b""
+    signature: bytes = b""
+
+
+@message
+class Metadata(Msg):
+    FIELDS = ((1, "value", "b"),
+              (2, "signatures", [("m", "MetadataSignature")]))
+    value: bytes = b""
+    signatures: List[MetadataSignature] = _f(default_factory=list)
+
+
+class BlockMetadataIndex:
+    SIGNATURES = 0
+    LAST_CONFIG = 1           # deprecated in ref; kept for layout parity
+    TRANSACTIONS_FILTER = 2
+    COMMIT_HASH = 4
+
+
+@message
+class BlockMetadata(Msg):
+    FIELDS = ((1, "metadata", ["b"]),)
+    metadata: List[bytes] = _f(default_factory=list)
+
+
+@message
+class Block(Msg):
+    FIELDS = ((1, "header", ("m", "BlockHeader")),
+              (2, "data", ("m", "BlockData")),
+              (3, "metadata", ("m", "BlockMetadata")))
+    header: Optional[BlockHeader] = None
+    data: Optional[BlockData] = None
+    metadata: Optional[BlockMetadata] = None
+
+
+@message
+class LastConfig(Msg):
+    FIELDS = ((1, "index", "u"),)
+    index: int = 0
+
+
+# --- msp/identities.proto --------------------------------------------------
+
+@message
+class SerializedIdentity(Msg):
+    FIELDS = ((1, "mspid", "s"), (2, "id_bytes", "b"))
+    mspid: str = ""
+    id_bytes: bytes = b""       # PEM cert
+
+
+# --- common/policies.proto -------------------------------------------------
+
+@message
+class NOutOf(Msg):
+    FIELDS = ((1, "n", "i"), (2, "rules", [("m", "SignaturePolicy")]))
+    n: int = 0
+    rules: List["SignaturePolicy"] = _f(default_factory=list)
+
+
+@message
+class SignaturePolicy(Msg):
+    # proto oneof: a leaf is signed_by (an identities index, 0 is
+    # meaningful so the usual zero-suppression cannot apply), an inner
+    # node is n_out_of.  Custom encode keeps the invariant explicit.
+    FIELDS = ((1, "signed_by", "i"), (2, "n_out_of", ("m", "NOutOf")))
+    signed_by: int = -1
+    n_out_of: Optional[NOutOf] = None
+
+    def encode(self) -> bytes:
+        from fabric_mod_tpu.protos import wire
+        out = bytearray()
+        if self.n_out_of is None:
+            wire._write_tag(out, 1, 0)
+            wire.write_varint(out, self.signed_by)
+        else:
+            wire._write_len_delim(out, 2, self.n_out_of.encode())
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "SignaturePolicy":
+        m = super().decode(buf)
+        # wire default for an inner node: mark leaf side unset
+        if m.n_out_of is not None:
+            m.signed_by = -1
+        return m
+
+
+class MSPRoleType:
+    MEMBER = 0
+    ADMIN = 1
+    CLIENT = 2
+    PEER = 3
+    ORDERER = 4
+
+
+@message
+class MSPRole(Msg):
+    FIELDS = ((1, "msp_identifier", "s"), (2, "role", "i"))
+    msp_identifier: str = ""
+    role: int = 0
+
+
+class PrincipalClassification:
+    ROLE = 0
+    ORGANIZATION_UNIT = 1
+    IDENTITY = 2
+
+
+@message
+class OrganizationUnit(Msg):
+    FIELDS = ((1, "msp_identifier", "s"),
+              (2, "organizational_unit_identifier", "s"),
+              (3, "certifiers_identifier", "b"))
+    msp_identifier: str = ""
+    organizational_unit_identifier: str = ""
+    certifiers_identifier: bytes = b""
+
+
+@message
+class MSPPrincipal(Msg):
+    FIELDS = ((1, "principal_classification", "i"), (2, "principal", "b"))
+    principal_classification: int = 0
+    principal: bytes = b""
+
+
+@message
+class SignaturePolicyEnvelope(Msg):
+    FIELDS = ((1, "version", "i"), (2, "rule", ("m", "SignaturePolicy")),
+              (3, "identities", [("m", "MSPPrincipal")]))
+    version: int = 0
+    rule: Optional[SignaturePolicy] = None
+    identities: List[MSPPrincipal] = _f(default_factory=list)
+
+
+@message
+class ApplicationPolicy(Msg):
+    # oneof: signature_policy or channel_config_policy_reference
+    FIELDS = ((1, "signature_policy", ("m", "SignaturePolicyEnvelope")),
+              (2, "channel_config_policy_reference", "s"))
+    signature_policy: Optional[SignaturePolicyEnvelope] = None
+    channel_config_policy_reference: str = ""
+
+
+# --- peer/chaincode.proto --------------------------------------------------
+
+@message
+class ChaincodeID(Msg):
+    FIELDS = ((1, "path", "s"), (2, "name", "s"), (3, "version", "s"))
+    path: str = ""
+    name: str = ""
+    version: str = ""
+
+
+@message
+class ChaincodeInput(Msg):
+    FIELDS = ((1, "args", ["b"]), (3, "is_init", "u"))
+    args: List[bytes] = _f(default_factory=list)
+    is_init: int = 0
+
+
+@message
+class ChaincodeSpec(Msg):
+    FIELDS = ((1, "type", "i"), (2, "chaincode_id", ("m", "ChaincodeID")),
+              (3, "input", ("m", "ChaincodeInput")), (4, "timeout", "i"))
+    type: int = 0
+    chaincode_id: Optional[ChaincodeID] = None
+    input: Optional[ChaincodeInput] = None
+    timeout: int = 0
+
+
+@message
+class ChaincodeInvocationSpec(Msg):
+    FIELDS = ((1, "chaincode_spec", ("m", "ChaincodeSpec")),)
+    chaincode_spec: Optional[ChaincodeSpec] = None
+
+
+@message
+class ChaincodeHeaderExtension(Msg):
+    FIELDS = ((2, "chaincode_id", ("m", "ChaincodeID")),)
+    chaincode_id: Optional[ChaincodeID] = None
+
+
+# --- peer/proposal.proto ---------------------------------------------------
+
+@message
+class Proposal(Msg):
+    FIELDS = ((1, "header", "b"), (2, "payload", "b"), (3, "extension", "b"))
+    header: bytes = b""
+    payload: bytes = b""
+    extension: bytes = b""
+
+
+@message
+class SignedProposal(Msg):
+    FIELDS = ((1, "proposal_bytes", "b"), (2, "signature", "b"))
+    proposal_bytes: bytes = b""
+    signature: bytes = b""
+
+
+@message
+class ChaincodeProposalPayload(Msg):
+    FIELDS = ((1, "input", "b"),)
+    input: bytes = b""          # ChaincodeInvocationSpec bytes
+
+
+@message
+class Response(Msg):
+    FIELDS = ((1, "status", "i"), (2, "message", "s"), (3, "payload", "b"))
+    status: int = 0
+    message: str = ""
+    payload: bytes = b""
+
+
+@message
+class Endorsement(Msg):
+    FIELDS = ((1, "endorser", "b"), (2, "signature", "b"))
+    endorser: bytes = b""       # SerializedIdentity bytes
+    signature: bytes = b""
+
+
+@message
+class ProposalResponse(Msg):
+    FIELDS = ((1, "version", "i"), (2, "timestamp", "u"),
+              (4, "response", ("m", "Response")), (5, "payload", "b"),
+              (6, "endorsement", ("m", "Endorsement")))
+    version: int = 0
+    timestamp: int = 0
+    response: Optional[Response] = None
+    payload: bytes = b""        # ProposalResponsePayload bytes
+    endorsement: Optional[Endorsement] = None
+
+
+@message
+class ChaincodeAction(Msg):
+    FIELDS = ((1, "results", "b"), (2, "events", "b"),
+              (3, "response", ("m", "Response")),
+              (4, "chaincode_id", ("m", "ChaincodeID")))
+    results: bytes = b""        # TxReadWriteSet bytes
+    events: bytes = b""
+    response: Optional[Response] = None
+    chaincode_id: Optional[ChaincodeID] = None
+
+
+@message
+class ProposalResponsePayload(Msg):
+    FIELDS = ((1, "proposal_hash", "b"), (2, "extension", "b"))
+    proposal_hash: bytes = b""
+    extension: bytes = b""      # ChaincodeAction bytes
+
+
+# --- peer/transaction.proto ------------------------------------------------
+
+@message
+class ChaincodeEndorsedAction(Msg):
+    FIELDS = ((1, "proposal_response_payload", "b"),
+              (2, "endorsements", [("m", "Endorsement")]))
+    proposal_response_payload: bytes = b""
+    endorsements: List[Endorsement] = _f(default_factory=list)
+
+
+@message
+class ChaincodeActionPayload(Msg):
+    FIELDS = ((1, "chaincode_proposal_payload", "b"),
+              (2, "action", ("m", "ChaincodeEndorsedAction")))
+    chaincode_proposal_payload: bytes = b""
+    action: Optional[ChaincodeEndorsedAction] = None
+
+
+@message
+class TransactionAction(Msg):
+    FIELDS = ((1, "header", "b"), (2, "payload", "b"))
+    header: bytes = b""         # SignatureHeader bytes
+    payload: bytes = b""        # ChaincodeActionPayload bytes
+
+
+@message
+class Transaction(Msg):
+    FIELDS = ((1, "actions", [("m", "TransactionAction")]),)
+    actions: List[TransactionAction] = _f(default_factory=list)
+
+
+@message
+class ProcessedTransaction(Msg):
+    FIELDS = ((1, "transaction_envelope", ("m", "Envelope")),
+              (2, "validation_code", "i"))
+    transaction_envelope: Optional[Envelope] = None
+    validation_code: int = 0
+
+
+# --- ledger/rwset ----------------------------------------------------------
+
+@message
+class Version(Msg):
+    FIELDS = ((1, "block_num", "u"), (2, "tx_num", "u"))
+    block_num: int = 0
+    tx_num: int = 0
+
+
+@message
+class KVRead(Msg):
+    FIELDS = ((1, "key", "s"), (2, "version", ("m", "Version")))
+    key: str = ""
+    version: Optional[Version] = None
+
+
+@message
+class KVWrite(Msg):
+    FIELDS = ((1, "key", "s"), (2, "is_delete", "u"), (3, "value", "b"))
+    key: str = ""
+    is_delete: int = 0
+    value: bytes = b""
+
+
+@message
+class RangeQueryInfo(Msg):
+    FIELDS = ((1, "start_key", "s"), (2, "end_key", "s"),
+              (3, "itr_exhausted", "u"), (4, "reads_merkle_hash", "b"))
+    start_key: str = ""
+    end_key: str = ""
+    itr_exhausted: int = 0
+    reads_merkle_hash: bytes = b""
+
+
+@message
+class KVRWSet(Msg):
+    FIELDS = ((1, "reads", [("m", "KVRead")]),
+              (2, "range_queries_info", [("m", "RangeQueryInfo")]),
+              (3, "writes", [("m", "KVWrite")]))
+    reads: List[KVRead] = _f(default_factory=list)
+    range_queries_info: List[RangeQueryInfo] = _f(default_factory=list)
+    writes: List[KVWrite] = _f(default_factory=list)
+
+
+@message
+class NsReadWriteSet(Msg):
+    FIELDS = ((1, "namespace", "s"), (2, "rwset", "b"))
+    namespace: str = ""
+    rwset: bytes = b""          # KVRWSet bytes
+
+
+@message
+class TxReadWriteSet(Msg):
+    FIELDS = ((1, "data_model", "i"),
+              (2, "ns_rwset", [("m", "NsReadWriteSet")]))
+    data_model: int = 0
+    ns_rwset: List[NsReadWriteSet] = _f(default_factory=list)

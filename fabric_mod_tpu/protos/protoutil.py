@@ -1,0 +1,158 @@
+"""Envelope/block/proposal construction and extraction helpers.
+
+The equivalent of the reference's protoutil package (reference:
+protoutil/commonutils.go, protoutil/proputils.go,
+protoutil/blockutils.go, protoutil/signeddata.go, protoutil/txutils.go)
+— every layer above builds and unpacks wire messages through here.
+
+Hashing conventions (deterministic, but intentionally *not* byte-
+compatible with the reference — this is a new framework, not a fork):
+* tx_id = hex(sha256(nonce ‖ creator)) — same recipe as the ref.
+* block data hash = sha256 over the concatenation of the block's tx
+  envelope encodings.
+* block header hash = sha256 of the header's wire encoding (the ref
+  uses ASN.1 here; ours is the same deterministic proto encoding used
+  everywhere else).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from fabric_mod_tpu.protos import messages as m
+
+
+@dataclass(frozen=True)
+class SignedData:
+    """The universal (data, identity, signature) triple every policy
+    check consumes (reference: protoutil/signeddata.go)."""
+    data: bytes
+    identity: bytes             # SerializedIdentity bytes
+    signature: bytes
+
+
+def compute_tx_id(nonce: bytes, creator: bytes) -> str:
+    return hashlib.sha256(nonce + creator).hexdigest()
+
+
+def new_nonce() -> bytes:
+    return os.urandom(24)
+
+
+def now_ns() -> int:
+    return time.time_ns()
+
+
+def make_channel_header(htype: int, channel_id: str, tx_id: str = "",
+                        epoch: int = 0, extension: bytes = b"",
+                        timestamp: Optional[int] = None) -> m.ChannelHeader:
+    return m.ChannelHeader(type=htype, version=0,
+                           timestamp=now_ns() if timestamp is None else timestamp,
+                           channel_id=channel_id, tx_id=tx_id, epoch=epoch,
+                           extension=extension)
+
+
+def make_signature_header(creator: bytes, nonce: bytes) -> m.SignatureHeader:
+    return m.SignatureHeader(creator=creator, nonce=nonce)
+
+
+def make_payload(ch: m.ChannelHeader, sh: m.SignatureHeader,
+                 data: bytes) -> m.Payload:
+    return m.Payload(
+        header=m.Header(channel_header=ch.encode(),
+                        signature_header=sh.encode()),
+        data=data)
+
+
+def sign_envelope(payload: m.Payload, signer) -> m.Envelope:
+    """signer: object with .sign_message(msg: bytes) -> bytes."""
+    pb = payload.encode()
+    return m.Envelope(payload=pb, signature=signer.sign_message(pb))
+
+
+def unmarshal_envelope_payload(env: m.Envelope) -> m.Payload:
+    return m.Payload.decode(env.payload)
+
+
+def envelope_channel_header(env: m.Envelope) -> m.ChannelHeader:
+    pl = m.Payload.decode(env.payload)
+    return m.ChannelHeader.decode(pl.header.channel_header)
+
+
+def envelope_as_signed_data(env: m.Envelope) -> List[SignedData]:
+    """(reference: protoutil/signeddata.go EnvelopeAsSignedData)."""
+    pl = m.Payload.decode(env.payload)
+    sh = m.SignatureHeader.decode(pl.header.signature_header)
+    return [SignedData(data=env.payload, identity=sh.creator,
+                       signature=env.signature)]
+
+
+# --- blocks ---------------------------------------------------------------
+
+def block_data_hash(data: m.BlockData) -> bytes:
+    h = hashlib.sha256()
+    for d in data.data:
+        h.update(d)
+    return h.digest()
+
+
+def block_header_hash(header: m.BlockHeader) -> bytes:
+    return hashlib.sha256(header.encode()).digest()
+
+
+def new_block(number: int, previous_hash: bytes,
+              envelopes: Sequence[m.Envelope]) -> m.Block:
+    data = m.BlockData(data=[e.encode() for e in envelopes])
+    header = m.BlockHeader(number=number, previous_hash=previous_hash,
+                           data_hash=block_data_hash(data))
+    ntx = len(data.data)
+    flags = bytes([m.TxValidationCode.NOT_VALIDATED] * ntx)
+    meta = m.BlockMetadata(metadata=[b"", b"", flags, b"", b""])
+    return m.Block(header=header, data=data, metadata=meta)
+
+
+def block_txflags(block: m.Block) -> bytearray:
+    """The per-tx validation-code bitmap stored in block metadata
+    (reference: internal/pkg/txflags)."""
+    md = block.metadata.metadata
+    idx = m.BlockMetadataIndex.TRANSACTIONS_FILTER
+    ntx = len(block.data.data)
+    if len(md) > idx and len(md[idx]) == ntx:
+        return bytearray(md[idx])
+    return bytearray([m.TxValidationCode.NOT_VALIDATED] * ntx)
+
+
+def set_block_txflags(block: m.Block, flags: bytes) -> None:
+    md = block.metadata.metadata
+    idx = m.BlockMetadataIndex.TRANSACTIONS_FILTER
+    while len(md) <= idx:
+        md.append(b"")
+    md[idx] = bytes(flags)
+
+
+def get_envelopes(block: m.Block) -> List[m.Envelope]:
+    return [m.Envelope.decode(d) for d in block.data.data]
+
+
+# --- transactions ----------------------------------------------------------
+
+def extract_endorser_tx(payload: m.Payload) -> m.Transaction:
+    return m.Transaction.decode(payload.data)
+
+
+def tx_rwset_and_endorsements(action: m.TransactionAction):
+    """Unpack one action -> (ChaincodeAction, prp_bytes, endorsements).
+
+    prp_bytes is the exact ProposalResponsePayload encoding the
+    endorsers signed over (together with the endorser identity) — the
+    signature-set data for endorsement-policy checks (reference:
+    core/common/validation/statebased/validator_keylevel.go:245-258).
+    """
+    cap = m.ChaincodeActionPayload.decode(action.payload)
+    prp_bytes = cap.action.proposal_response_payload
+    prp = m.ProposalResponsePayload.decode(prp_bytes)
+    cca = m.ChaincodeAction.decode(prp.extension)
+    return cca, prp_bytes, cap.action.endorsements

@@ -1,0 +1,146 @@
+"""MSP tests: chain validation, roles, principals, caches — modeled on
+the reference's msp/testdata scenario matrix (expired, wrong CA,
+revoked, NodeOUs) but with fixtures generated on the fly."""
+import datetime
+
+import pytest
+
+from fabric_mod_tpu.bccsp.sw import SwCSP
+from fabric_mod_tpu.msp import ca as calib
+from fabric_mod_tpu.msp.cache import CachedMsp
+from fabric_mod_tpu.msp.identities import SigningIdentity, deserialize_cert
+from fabric_mod_tpu.msp.mspimpl import Msp, MspManager, MSPValidationError
+from fabric_mod_tpu.protos import messages as m
+
+
+@pytest.fixture(scope="module")
+def org():
+    csp = SwCSP()
+    root = calib.CA("ca.org1.example.com", "Org1")
+    inter = calib.CA.__new__(calib.CA)          # intermediate signed by root
+    cert, key = root.issue("ica.org1.example.com", "Org1", is_ca=True)
+    inter.cert, inter.key = cert, key
+    peer_cert, peer_key = inter.issue("peer0.org1", "Org1", ous=["peer"])
+    admin_cert, admin_key = root.issue("admin@org1", "Org1", ous=["admin"])
+    client_cert, client_key = inter.issue("user1@org1", "Org1", ous=["client"])
+    msp = Msp("Org1MSP", csp, [root.cert], [inter.cert])
+    return dict(csp=csp, root=root, inter=inter, msp=msp,
+                peer=(peer_cert, peer_key), admin=(admin_cert, admin_key),
+                client=(client_cert, client_key))
+
+
+def _ident(org, which):
+    cert, key = org[which]
+    return SigningIdentity("Org1MSP", cert, calib.key_pem(key), org["csp"])
+
+
+def test_serialize_deserialize_roundtrip(org):
+    ident = _ident(org, "peer")
+    got = org["msp"].deserialize_identity(ident.serialize())
+    assert got.common_name() == "peer0.org1"
+    assert got.ski() == ident.ski()
+
+
+def test_validate_chain_through_intermediate(org):
+    org["msp"].validate(_ident(org, "peer"))      # inter-signed
+    org["msp"].validate(_ident(org, "admin"))     # root-signed
+
+
+def test_foreign_ca_rejected(org):
+    evil = calib.CA("ca.evil.example.com", "Evil")
+    cert, key = evil.issue("peer0.org1", "Org1", ous=["peer"])
+    ident = SigningIdentity("Org1MSP", cert, calib.key_pem(key), org["csp"])
+    with pytest.raises(MSPValidationError):
+        org["msp"].validate(ident)
+
+
+def test_expired_cert_rejected(org):
+    past = (datetime.datetime.now(datetime.timezone.utc)
+            - datetime.timedelta(days=1))
+    cert, key = org["root"].issue("old@org1", "Org1", not_after=past)
+    ident = SigningIdentity("Org1MSP", cert, calib.key_pem(key), org["csp"])
+    with pytest.raises(MSPValidationError, match="validity"):
+        org["msp"].validate(ident)
+
+
+def test_revoked_cert_rejected(org):
+    cert, key = org["root"].issue("gone@org1", "Org1")
+    msp = Msp("Org1MSP", org["csp"], [org["root"].cert],
+              revoked_serials=[cert.serial_number])
+    ident = SigningIdentity("Org1MSP", cert, calib.key_pem(key), org["csp"])
+    with pytest.raises(MSPValidationError, match="revoked"):
+        msp.validate(ident)
+
+
+def _role_principal(role, mspid="Org1MSP"):
+    return m.MSPPrincipal(
+        principal_classification=m.PrincipalClassification.ROLE,
+        principal=m.MSPRole(msp_identifier=mspid, role=role).encode())
+
+
+def test_role_principals(org):
+    msp = org["msp"]
+    peer, admin, client = (_ident(org, w) for w in ("peer", "admin", "client"))
+    assert msp.satisfies_principal(peer, _role_principal(m.MSPRoleType.MEMBER))
+    assert msp.satisfies_principal(peer, _role_principal(m.MSPRoleType.PEER))
+    assert not msp.satisfies_principal(peer, _role_principal(m.MSPRoleType.ADMIN))
+    assert msp.satisfies_principal(admin, _role_principal(m.MSPRoleType.ADMIN))
+    assert msp.satisfies_principal(client, _role_principal(m.MSPRoleType.CLIENT))
+    assert not msp.satisfies_principal(
+        peer, _role_principal(m.MSPRoleType.MEMBER, "OtherMSP"))
+
+
+def test_identity_and_ou_principals(org):
+    msp = org["msp"]
+    peer = _ident(org, "peer")
+    ip = m.MSPPrincipal(
+        principal_classification=m.PrincipalClassification.IDENTITY,
+        principal=peer.serialize())
+    assert msp.satisfies_principal(peer, ip)
+    assert not msp.satisfies_principal(_ident(org, "client"), ip)
+    oup = m.MSPPrincipal(
+        principal_classification=m.PrincipalClassification.ORGANIZATION_UNIT,
+        principal=m.OrganizationUnit(
+            msp_identifier="Org1MSP",
+            organizational_unit_identifier="peer").encode())
+    assert msp.satisfies_principal(peer, oup)
+    assert not msp.satisfies_principal(_ident(org, "client"), oup)
+
+
+def test_sign_verify_through_identity(org):
+    ident = _ident(org, "peer")
+    sig = ident.sign_message(b"payload")
+    assert ident.verify(b"payload", sig)
+    assert not ident.verify(b"payload!", sig)
+    item = ident.verify_item(b"payload", sig)
+    assert item is not None and len(item.public_xy) == 64
+
+
+def test_manager_routes_by_mspid(org):
+    other_ca = calib.CA("ca.org2", "Org2")
+    msp2 = Msp("Org2MSP", org["csp"], [other_ca.cert])
+    mgr = MspManager([org["msp"], msp2])
+    ident = _ident(org, "peer")
+    got = mgr.deserialize_identity(ident.serialize())
+    assert got.mspid == "Org1MSP"
+    with pytest.raises(MSPValidationError, match="unknown MSP"):
+        mgr.deserialize_identity(
+            m.SerializedIdentity(mspid="NopeMSP", id_bytes=b"x").encode())
+
+
+def test_cached_msp_agrees(org):
+    cached = CachedMsp(org["msp"])
+    ident = _ident(org, "peer")
+    for _ in range(3):
+        got = cached.deserialize_identity(ident.serialize())
+        assert got.common_name() == "peer0.org1"
+        cached.validate(got)
+        assert cached.satisfies_principal(
+            got, _role_principal(m.MSPRoleType.PEER))
+    # negative result cached too
+    evil = calib.CA("ca.evil", "Evil")
+    cert, key = evil.issue("x", "Evil")
+    bad = SigningIdentity("Org1MSP", cert, calib.key_pem(key), org["csp"])
+    for _ in range(2):
+        with pytest.raises(MSPValidationError):
+            cached.validate(bad)

@@ -1,0 +1,109 @@
+"""Wire-format determinism, roundtrips, and protoutil helpers."""
+import hashlib
+
+import pytest
+
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil, wire
+
+
+def test_varint_roundtrip():
+    buf = bytearray()
+    vals = [0, 1, 127, 128, 300, 2**32, 2**63, 2**64 - 1]
+    for v in vals:
+        wire.write_varint(buf, v)
+    pos = 0
+    for v in vals:
+        got, pos = wire.read_varint(bytes(buf), pos)
+        assert got == v
+    assert pos == len(buf)
+
+
+def test_message_roundtrip_and_determinism():
+    ch = m.ChannelHeader(type=m.HeaderType.ENDORSER_TRANSACTION,
+                         channel_id="mychannel", tx_id="ab" * 32,
+                         timestamp=1234567890, epoch=0)
+    sh = m.SignatureHeader(creator=b"creator-bytes", nonce=b"n" * 24)
+    pl = protoutil.make_payload(ch, sh, b"tx-data")
+    env = m.Envelope(payload=pl.encode(), signature=b"sig")
+    enc1 = env.encode()
+    env2 = m.Envelope.decode(enc1)
+    assert env2 == env
+    assert env2.encode() == enc1                 # deterministic re-encode
+    ch2 = protoutil.envelope_channel_header(env2)
+    assert ch2 == ch
+
+
+def test_unknown_fields_tolerated():
+    # craft bytes with an extra field number 15
+    buf = bytearray()
+    wire._write_tag(buf, 15, 2)
+    wire.write_varint(buf, 3)
+    buf.extend(b"xyz")
+    base = m.SignatureHeader(creator=b"c", nonce=b"n").encode()
+    got = m.SignatureHeader.decode(base + bytes(buf))
+    assert got.creator == b"c" and got.nonce == b"n"
+
+
+def test_truncated_input_raises():
+    good = m.SignatureHeader(creator=b"c" * 20).encode()
+    with pytest.raises(ValueError):
+        m.SignatureHeader.decode(good[:-3])   # cuts into the creator bytes
+
+
+def test_signature_policy_oneof():
+    leaf0 = m.SignaturePolicy(signed_by=0)
+    leaf2 = m.SignaturePolicy(signed_by=2)
+    node = m.SignaturePolicy(n_out_of=m.NOutOf(n=2, rules=[leaf0, leaf2]))
+    env = m.SignaturePolicyEnvelope(
+        version=0, rule=node,
+        identities=[m.MSPPrincipal(principal=b"p0"),
+                    m.MSPPrincipal(principal=b"p2")])
+    got = m.SignaturePolicyEnvelope.decode(env.encode())
+    assert got.rule.n_out_of.n == 2
+    assert [r.signed_by for r in got.rule.n_out_of.rules] == [0, 2]
+    assert got.rule.n_out_of.rules[0].n_out_of is None
+
+
+def test_block_roundtrip_and_hash_chain():
+    envs = [m.Envelope(payload=f"tx{i}".encode(), signature=b"s")
+            for i in range(3)]
+    b0 = protoutil.new_block(0, b"", envs)
+    b1 = protoutil.new_block(1, protoutil.block_header_hash(b0.header), envs)
+    assert b1.header.previous_hash == hashlib.sha256(b0.header.encode()).digest()
+    dec = m.Block.decode(b1.encode())
+    assert dec == b1
+    assert [e.payload for e in protoutil.get_envelopes(dec)] == \
+        [b"tx0", b"tx1", b"tx2"]
+    flags = protoutil.block_txflags(dec)
+    assert list(flags) == [m.TxValidationCode.NOT_VALIDATED] * 3
+    flags[1] = m.TxValidationCode.VALID
+    protoutil.set_block_txflags(dec, flags)
+    assert protoutil.block_txflags(dec)[1] == m.TxValidationCode.VALID
+
+
+def test_txid_and_signed_data():
+    nonce, creator = b"n" * 24, b"creator"
+    txid = protoutil.compute_tx_id(nonce, creator)
+    assert txid == hashlib.sha256(nonce + creator).hexdigest()
+    ch = protoutil.make_channel_header(3, "ch", tx_id=txid)
+    pl = protoutil.make_payload(ch, m.SignatureHeader(creator, nonce), b"d")
+    env = m.Envelope(payload=pl.encode(), signature=b"sig")
+    (sd,) = protoutil.envelope_as_signed_data(env)
+    assert sd.identity == creator and sd.data == env.payload
+
+
+def test_rwset_roundtrip():
+    rw = m.TxReadWriteSet(data_model=0, ns_rwset=[
+        m.NsReadWriteSet(namespace="cc1", rwset=m.KVRWSet(
+            reads=[m.KVRead(key="a", version=m.Version(3, 1))],
+            writes=[m.KVWrite(key="b", value=b"v"),
+                    m.KVWrite(key="c", is_delete=1)],
+        ).encode())])
+    got = m.TxReadWriteSet.decode(rw.encode())
+    kv = m.KVRWSet.decode(got.ns_rwset[0].rwset)
+    assert kv.reads[0].version.block_num == 3
+    assert kv.writes[1].is_delete == 1
+    # zero-valued version (genesis reads) survives
+    kv0 = m.KVRWSet(reads=[m.KVRead(key="x", version=None)])
+    assert m.KVRWSet.decode(kv0.encode()).reads[0].version is None
