@@ -144,15 +144,20 @@ class BatchingVerifyService:
         self.deadline_s = deadline_s
         self._q: "queue.Queue[tuple[VerifyItem, Future]]" = queue.Queue()
         self._stop = threading.Event()
+        self._lifecycle = threading.Lock()   # serializes submit vs close
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
     def submit(self, item: VerifyItem) -> Future:
         fut: Future = Future()
-        if self._stop.is_set():
-            fut.set_exception(RuntimeError("verify service is closed"))
-            return fut
-        self._q.put((item, fut))
+        # Under the lock, either close() has not started (the item lands
+        # before close()'s straggler drain) or it has finished setting
+        # _stop (we reject here) — no orphaned Futures either way.
+        with self._lifecycle:
+            if self._stop.is_set():
+                fut.set_exception(RuntimeError("verify service is closed"))
+                return fut
+            self._q.put((item, fut))
         return fut
 
     def verify(self, item: VerifyItem, timeout: Optional[float] = 30) -> bool:
@@ -161,7 +166,8 @@ class BatchingVerifyService:
     def close(self) -> None:
         """Stop the worker, draining: everything already submitted still
         gets a verdict (callers may be blocked on their Futures)."""
-        self._stop.set()
+        with self._lifecycle:
+            self._stop.set()
         self._worker.join(timeout=30)
         # A submit may have raced the worker's final drain; fail any
         # stragglers rather than leaving callers hung.

@@ -51,22 +51,36 @@ class CA:
 
     def issue(self, cn: str, org: Optional[str] = None,
               ous: Optional[list] = None, is_ca: bool = False,
-              valid_days: int = 3650, not_after=None,
+              valid_days: int = 3650, not_after=None, not_before=None,
               key: Optional[ec.EllipticCurvePrivateKey] = None):
-        """Issue a cert; returns (cert, private_key)."""
+        """Issue a cert; returns (cert, private_key).
+
+        An explicit past `not_after` yields a genuinely expired cert:
+        `not_valid_before` is pushed before it so builder validation
+        holds and the expiry fixture actually exercises the window
+        check.
+        """
         key = key or ec.generate_private_key(ec.SECP256R1())
         now = datetime.datetime.now(datetime.timezone.utc)
+        nva = not_after or now + datetime.timedelta(days=valid_days)
+        nvb = not_before or min(now - datetime.timedelta(minutes=5),
+                                nva - datetime.timedelta(minutes=1))
         builder = (
             x509.CertificateBuilder()
             .subject_name(_name(cn, org, ous))
             .issuer_name(self.cert.subject)
             .public_key(key.public_key())
             .serial_number(x509.random_serial_number())
-            .not_valid_before(now - datetime.timedelta(minutes=5))
-            .not_valid_after(not_after or
-                             now + datetime.timedelta(days=valid_days))
+            .not_valid_before(nvb)
+            .not_valid_after(nva)
             .add_extension(x509.BasicConstraints(ca=is_ca, path_length=None),
                            critical=True))
+        if not is_ca:
+            builder = builder.add_extension(x509.KeyUsage(
+                digital_signature=True, key_cert_sign=False, crl_sign=False,
+                content_commitment=False, key_encipherment=False,
+                data_encipherment=False, key_agreement=False,
+                encipher_only=False, decipher_only=False), critical=True)
         cert = builder.sign(self.key, hashes.SHA256())
         return cert, key
 

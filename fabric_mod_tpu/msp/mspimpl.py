@@ -40,8 +40,25 @@ def _check_link(child: x509.Certificate, issuer: x509.Certificate) -> bool:
             pub.verify(child.signature, child.tbs_certificate_bytes,
                        _pad.PKCS1v15(), child.signature_hash_algorithm)
         return True
-    except (InvalidSignature, Exception):
+    except InvalidSignature:
         return False
+    except Exception:
+        # Attacker-supplied certs can raise far beyond InvalidSignature
+        # (UnsupportedAlgorithm on unknown sig-alg OIDs, ValueError /
+        # TypeError on malformed params); any failure to verify the
+        # link is a non-link, never a crash on the validation path.
+        return False
+
+
+def _is_ca_cert(cert: x509.Certificate) -> bool:
+    try:
+        bc = cert.extensions.get_extension_for_class(
+            x509.BasicConstraints).value
+        return bool(bc.ca)
+    except x509.ExtensionNotFound:
+        return False
+    except Exception as e:               # duplicate/malformed extensions
+        raise MSPValidationError(f"malformed certificate extensions: {e}")
 
 
 class NodeOUs:
@@ -62,6 +79,7 @@ class Msp:
                  intermediate_certs: Sequence[x509.Certificate] = (),
                  admin_certs: Sequence[x509.Certificate] = (),
                  revoked_serials: Sequence[int] = (),
+                 crls: Sequence[x509.CertificateRevocationList] = (),
                  node_ous: Optional[NodeOUs] = None):
         self.mspid = mspid
         self._csp = csp
@@ -74,6 +92,21 @@ class Msp:
         self._root_fps = {cert_fingerprint(c) for c in self.roots}
         self._admin_fps = {cert_fingerprint(c) for c in admin_certs}
         self._revoked = set(revoked_serials)
+        # CRLs (reference: msp/mspimplvalidate.go isIdentityRevoked):
+        # only CRLs verifiably signed by one of our CAs contribute, and
+        # each entry revokes (issuer, serial) — serials are only unique
+        # per CA, so a CRL from CA1 must not shadow CA2's serial space.
+        self._crl_revoked: set = set()   # {(issuer_subject_der, serial)}
+        for crl in crls:
+            issuer_cands = self._by_subject.get(
+                crl.issuer.public_bytes(), [])
+            if not any(crl.is_signature_valid(c.public_key())
+                       for c in issuer_cands):
+                raise MSPValidationError(
+                    "CRL not signed by a trusted CA of this MSP")
+            for rc in crl:
+                self._crl_revoked.add(
+                    (crl.issuer.public_bytes(), rc.serial_number))
         self.node_ous = node_ous or NodeOUs()
 
     # -- identity lifecycle --
@@ -87,16 +120,49 @@ class Msp:
 
     def validate(self, ident: Identity) -> None:
         """Raise MSPValidationError unless the identity chains to our
-        roots and is unexpired/unrevoked."""
+        roots and is unexpired/unrevoked.
+
+        CA certificates are not identities (reference:
+        msp/mspimpl.go:713-716 'A CA certificate cannot be used
+        directly as an identity', chain length >= 2 at
+        mspimpl.go:747-749): a leaf with BasicConstraints CA=true — or
+        one of the trust anchors themselves — is rejected outright.
+        """
+        if _is_ca_cert(ident.cert):
+            raise MSPValidationError(
+                "a CA certificate cannot be used as an identity")
         chain = self._chain_for(ident.cert)
+        if len(chain) < 2:
+            raise MSPValidationError(
+                "identity chain must include at least one CA above the leaf")
         now = datetime.datetime.now(datetime.timezone.utc)
         for cert in chain:
             if now < cert.not_valid_before_utc or now > cert.not_valid_after_utc:
                 raise MSPValidationError(
                     f"certificate {cert.subject.rfc4514_string()!r} outside"
                     " validity window")
-        if ident.cert.serial_number in self._revoked:
-            raise MSPValidationError("certificate revoked")
+            # Revocation applies to the whole chain: a revoked
+            # intermediate invalidates everything beneath it.
+            if (cert.serial_number in self._revoked
+                    or (cert.issuer.public_bytes(), cert.serial_number)
+                    in self._crl_revoked):
+                raise MSPValidationError("certificate revoked")
+        self._check_key_usage(ident.cert)
+
+    @staticmethod
+    def _check_key_usage(cert: x509.Certificate) -> None:
+        """Leaves carrying a KeyUsage extension must allow
+        digitalSignature — identities exist to sign."""
+        try:
+            ku = cert.extensions.get_extension_for_class(x509.KeyUsage).value
+        except x509.ExtensionNotFound:
+            return
+        except Exception as e:           # duplicate/malformed extensions
+            raise MSPValidationError(
+                f"malformed certificate extensions: {e}")
+        if not ku.digital_signature:
+            raise MSPValidationError(
+                "leaf KeyUsage does not permit digitalSignature")
 
     def is_valid(self, ident: Identity) -> bool:
         try:

@@ -123,6 +123,13 @@ class Msg:
             attr, kind = by_num[num]
             rep = isinstance(kind, list)
             k = kind[0] if rep else kind
+            # Wire type must match the declared kind: a varint arriving on
+            # a bytes field (or vice versa) is a malformed message, not a
+            # value to coerce — this runs on untrusted envelope bytes.
+            expect_wt = 0 if k in ("u", "i") else 2
+            if wt != expect_wt:
+                raise ValueError(
+                    f"field {num}: wire type {wt}, expected {expect_wt}")
             if k == "u" or k == "i":
                 item: Any = int(payload)
                 if k == "i" and item >= 1 << 63:

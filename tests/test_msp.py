@@ -72,6 +72,87 @@ def test_revoked_cert_rejected(org):
         msp.validate(ident)
 
 
+def test_ca_cert_rejected_as_identity(org):
+    """Reference: msp/mspimpl.go:713-716 — a CA certificate (root,
+    intermediate, or any leaf with CA=true) is not an identity."""
+    root_ident = SigningIdentity(
+        "Org1MSP", org["root"].cert, calib.key_pem(org["root"].key),
+        org["csp"])
+    with pytest.raises(MSPValidationError, match="CA certificate"):
+        org["msp"].validate(root_ident)
+    inter_ident = SigningIdentity(
+        "Org1MSP", org["inter"].cert, calib.key_pem(org["inter"].key),
+        org["csp"])
+    with pytest.raises(MSPValidationError, match="CA certificate"):
+        org["msp"].validate(inter_ident)
+
+
+def test_revoked_intermediate_poisons_leaf(org):
+    cert, key = org["inter"].issue("victim@org1", "Org1")
+    msp = Msp("Org1MSP", org["csp"], [org["root"].cert],
+              [org["inter"].cert],
+              revoked_serials=[org["inter"].cert.serial_number])
+    ident = SigningIdentity("Org1MSP", cert, calib.key_pem(key), org["csp"])
+    with pytest.raises(MSPValidationError, match="revoked"):
+        msp.validate(ident)
+
+
+def test_crl_revocation(org):
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert, key = org["root"].issue("crled@org1", "Org1")
+    crl = (x509.CertificateRevocationListBuilder()
+           .issuer_name(org["root"].cert.subject)
+           .last_update(now).next_update(now + datetime.timedelta(days=7))
+           .add_revoked_certificate(
+               x509.RevokedCertificateBuilder()
+               .serial_number(cert.serial_number)
+               .revocation_date(now).build())
+           .sign(org["root"].key, hashes.SHA256()))
+    msp = Msp("Org1MSP", org["csp"], [org["root"].cert], crls=[crl])
+    ident = SigningIdentity("Org1MSP", cert, calib.key_pem(key), org["csp"])
+    with pytest.raises(MSPValidationError, match="revoked"):
+        msp.validate(ident)
+    # a CRL from an untrusted issuer is refused outright
+    evil = calib.CA("ca.evil", "Evil")
+    bad_crl = (x509.CertificateRevocationListBuilder()
+               .issuer_name(evil.cert.subject)
+               .last_update(now).next_update(now + datetime.timedelta(days=7))
+               .sign(evil.key, hashes.SHA256()))
+    with pytest.raises(MSPValidationError, match="CRL"):
+        Msp("Org1MSP", org["csp"], [org["root"].cert], crls=[bad_crl])
+
+
+def test_key_usage_enforced(org):
+    """A leaf whose KeyUsage forbids digitalSignature can't sign —
+    reject it at validation time."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec as _ec
+    key = _ec.generate_private_key(_ec.SECP256R1())
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(x509.Name([x509.NameAttribute(
+                x509.oid.NameOID.COMMON_NAME, "enc-only@org1")]))
+            .issuer_name(org["root"].cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(x509.BasicConstraints(ca=False, path_length=None),
+                           critical=True)
+            .add_extension(x509.KeyUsage(
+                digital_signature=False, key_cert_sign=False, crl_sign=False,
+                content_commitment=False, key_encipherment=True,
+                data_encipherment=False, key_agreement=False,
+                encipher_only=False, decipher_only=False), critical=True)
+            .sign(org["root"].key, hashes.SHA256()))
+    ident = SigningIdentity("Org1MSP", cert, calib.key_pem(key), org["csp"])
+    with pytest.raises(MSPValidationError, match="KeyUsage"):
+        org["msp"].validate(ident)
+
+
 def _role_principal(role, mspid="Org1MSP"):
     return m.MSPPrincipal(
         principal_classification=m.PrincipalClassification.ROLE,

@@ -51,6 +51,23 @@ def test_truncated_input_raises():
         m.SignatureHeader.decode(good[:-3])   # cuts into the creator bytes
 
 
+def test_wire_type_mismatch_rejected():
+    # A varint arriving on a bytes field must raise, not allocate
+    # payload-many zero bytes (crafted-input DoS on envelope decode).
+    buf = bytearray()
+    wire._write_tag(buf, 1, 0)                # field 1 (creator: bytes), wt 0
+    wire.write_varint(buf, 10 * 1024 * 1024)  # "10MB" as a varint
+    with pytest.raises(ValueError, match="wire type"):
+        m.SignatureHeader.decode(bytes(buf))
+    # and a length-delimited payload on a varint field likewise
+    buf2 = bytearray()
+    wire._write_tag(buf2, 1, 2)               # ChannelHeader.type is varint
+    wire.write_varint(buf2, 1)
+    buf2.extend(b"x")
+    with pytest.raises(ValueError, match="wire type"):
+        m.ChannelHeader.decode(bytes(buf2))
+
+
 def test_signature_policy_oneof():
     leaf0 = m.SignaturePolicy(signed_by=0)
     leaf2 = m.SignaturePolicy(signed_by=2)
