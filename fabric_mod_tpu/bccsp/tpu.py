@@ -61,14 +61,16 @@ from fabric_mod_tpu.ops.p256 import N as _P256_N  # noqa: E402
 _LOW_S_MAX = _P256_N // 2
 
 
-def _bucket(n: int) -> int:
-    """Smallest static bucket holding n; n must be <= max bucket
-    (larger batches are chunked by the caller so the set of compiled
-    program shapes stays fixed)."""
+def _bucket(n: int, min_div: int = 1) -> int:
+    """Smallest static bucket holding n that `min_div` divides (the
+    mesh size must divide the sharded batch axis evenly); n must be
+    <= max bucket (larger batches are chunked by the caller so the
+    set of compiled program shapes stays fixed)."""
     for b in BUCKETS:
-        if n <= b:
+        if n <= b and b % min_div == 0:
             return b
-    raise ValueError(f"batch {n} exceeds max bucket {BUCKETS[-1]}")
+    raise ValueError(
+        f"no bucket >= {n} divisible by {min_div} (max {BUCKETS[-1]})")
 
 
 class TpuVerifier:
@@ -78,7 +80,25 @@ class TpuVerifier:
     fake with the same shape) can depend on just this seam — the
     equivalent of the reference's narrow per-consumer interfaces
     (SURVEY.md §4).
+
+    Pass a `mesh` (parallel.data_mesh) to shard each bucket's batch
+    axis across chips; bucket selection then skips buckets the mesh
+    size does not divide, so the partition is always even.  The mesh
+    size must divide the largest bucket (i.e. be a power of two
+    <= 2048) — checked at construction.
     """
+
+    def __init__(self, mesh=None):
+        self._sharding = None
+        self._mesh_size = 1
+        if mesh is not None:
+            from fabric_mod_tpu.parallel import batch_sharding
+            self._mesh_size = int(np.prod(mesh.devices.shape))
+            if BUCKETS[-1] % self._mesh_size != 0:
+                raise ValueError(
+                    f"mesh size {self._mesh_size} must divide the max "
+                    f"bucket {BUCKETS[-1]} (use a power-of-two mesh)")
+            self._sharding = batch_sharding(mesh)
 
     def verify_many(self, items: Sequence[VerifyItem]) -> np.ndarray:
         n = len(items)
@@ -89,7 +109,7 @@ class TpuVerifier:
             return np.concatenate([
                 self.verify_many(items[i:i + BUCKETS[-1]])
                 for i in range(0, n, BUCKETS[-1])])
-        size = _bucket(n)
+        size = _bucket(n, self._mesh_size)
         d = np.zeros((size, 32), np.uint8)
         r = np.zeros((size, 32), np.uint8)
         s = np.zeros((size, 32), np.uint8)
@@ -112,7 +132,7 @@ class TpuVerifier:
             except Exception:
                 continue
         from fabric_mod_tpu.ops import p256
-        mask = p256.batch_verify(d, r, s, qx, qy)
+        mask = p256.batch_verify(d, r, s, qx, qy, sharding=self._sharding)
         return (mask & pre_ok)[:n]
 
 

@@ -234,14 +234,15 @@ def _lt_bytes(a: np.ndarray, b_: bytes) -> np.ndarray:
     return np.where(any_nz, firstval < 0, False)
 
 
-def batch_verify(digests: np.ndarray, r_bytes: np.ndarray,
-                 s_bytes: np.ndarray, qx_bytes: np.ndarray,
-                 qy_bytes: np.ndarray) -> np.ndarray:
-    """Verify a batch of ECDSA-P256 signatures over 32-byte digests.
+def marshal_inputs(digests: np.ndarray, r_bytes: np.ndarray,
+                   s_bytes: np.ndarray, qx_bytes: np.ndarray,
+                   qy_bytes: np.ndarray):
+    """Host prologue shared by batch_verify and the driver entry
+    points: range checks + byte->limb marshalling.
 
-    All args are (batch, 32) uint8 big-endian.  Returns (batch,) bool.
-    Host does only range checks + byte->limb marshalling; all field math
-    runs in one jitted device program.
+    Returns (core_args, range_ok): `core_args` is the positional tuple
+    for verify_core (numpy limb arrays + rn_lt_p flags), `range_ok` the
+    host-side scalar-range verdict to AND into the device mask.
     """
     digests = np.asarray(digests, np.uint8)
     r_bytes = np.asarray(r_bytes, np.uint8)
@@ -256,13 +257,36 @@ def batch_verify(digests: np.ndarray, r_bytes: np.ndarray,
                 & _lt_bytes(qx_bytes, _P_BYTES)
                 & _lt_bytes(qy_bytes, _P_BYTES))
     rn_lt_p = _lt_bytes(r_bytes, _P_MINUS_N_BYTES)
+    core_args = (be_bytes_to_limbs(digests), be_bytes_to_limbs(r_bytes),
+                 be_bytes_to_limbs(s_bytes), be_bytes_to_limbs(qx_bytes),
+                 be_bytes_to_limbs(qy_bytes), rn_lt_p)
+    return core_args, range_ok
 
-    ok = verify_core(
-        jnp.asarray(be_bytes_to_limbs(digests)),
-        jnp.asarray(be_bytes_to_limbs(r_bytes)),
-        jnp.asarray(be_bytes_to_limbs(s_bytes)),
-        jnp.asarray(be_bytes_to_limbs(qx_bytes)),
-        jnp.asarray(be_bytes_to_limbs(qy_bytes)),
-        jnp.asarray(rn_lt_p),
-    )
+
+def batch_verify(digests: np.ndarray, r_bytes: np.ndarray,
+                 s_bytes: np.ndarray, qx_bytes: np.ndarray,
+                 qy_bytes: np.ndarray, sharding=None) -> np.ndarray:
+    """Verify a batch of ECDSA-P256 signatures over 32-byte digests.
+
+    All args are (batch, 32) uint8 big-endian.  Returns (batch,) bool.
+    Host does only range checks + byte->limb marshalling; all field math
+    runs in one jitted device program.
+
+    `sharding` (optional jax.sharding.Sharding over the leading batch
+    axis, see parallel/mesh.py) places the limb arrays across a device
+    mesh before the call, so GSPMD partitions the same jitted program
+    across chips — multi-chip is a data-placement decision, not a
+    different code path.  The batch must then divide the mesh size
+    (every bucket in bccsp/tpu.py does).
+    """
+    core_args, range_ok = marshal_inputs(
+        digests, r_bytes, s_bytes, qx_bytes, qy_bytes)
+
+    def _dev(x):
+        arr = jnp.asarray(x)
+        if sharding is not None:
+            arr = jax.device_put(arr, sharding)
+        return arr
+
+    ok = verify_core(*(_dev(a) for a in core_args))
     return np.asarray(ok) & range_ok

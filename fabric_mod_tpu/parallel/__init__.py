@@ -1,0 +1,7 @@
+"""Device-mesh parallelism utilities (dp sharding of crypto batches).
+
+See mesh.py for the design rationale; SURVEY.md §2.9 maps the
+reference's goroutine-per-tx fan-out to the batch axis sharded here.
+"""
+from fabric_mod_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding, data_mesh, replicated)

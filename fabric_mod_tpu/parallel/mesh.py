@@ -1,0 +1,52 @@
+"""Device-mesh data parallelism for the batch crypto path.
+
+The reference's only intra-node parallel axis on the commit path is
+"one goroutine per transaction behind a semaphore" (reference:
+core/committer/txvalidator/v20/validator.go:194-239 and the pool knob
+at core/peer/config.go:255-258).  The TPU-native equivalent (SURVEY.md
+§2.9 row 1) is the batch dimension of the verify kernel, sharded over
+a 1-D `dp` device mesh: inputs are placed with a `NamedSharding` whose
+leading (batch) axis is split across chips, and XLA/GSPMD partitions
+the already-jitted verify program — no per-device code, no collectives
+beyond the final verdict gather, because signature verification is
+embarrassingly parallel across items (SURVEY.md §5.7: batch is the
+only parallel axis; nothing rides ICI except the result).
+
+Multi-host later: the same mesh spec over jax.distributed processes;
+the sharding annotations do not change.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def data_mesh(n_devices: Optional[int] = None):
+    """A 1-D ``("dp",)`` mesh over the first `n_devices` devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), ("dp",))
+
+
+def batch_sharding(mesh):
+    """NamedSharding splitting the leading (batch) axis across `dp`.
+
+    Applies to every per-item array of the verify step: (batch, K)
+    limb arrays and (batch,) flag vectors alike — PartitionSpec("dp")
+    constrains only the leading axis, trailing axes stay replicated.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P("dp"))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
