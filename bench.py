@@ -28,30 +28,13 @@ def log(msg: str) -> None:
 
 
 def make_items(n: int, n_keys: int = 64):
-    """n real signatures (~0.4% deliberately invalid) as VerifyItems."""
-    from cryptography.hazmat.primitives import hashes
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.hazmat.primitives.asymmetric.utils import Prehashed
+    """n real signatures (~0.4% deliberately invalid) as VerifyItems,
+    from the shared fixture generator (sw-provider signing, so low-S
+    normalized like production)."""
+    from fabric_mod_tpu.utils.fixtures import make_verify_items
 
-    from fabric_mod_tpu.bccsp.api import VerifyItem
-    from fabric_mod_tpu.bccsp.sw import normalize_low_s, point_bytes
-
-    keys = [ec.generate_private_key(ec.SECP256R1()) for _ in range(n_keys)]
-    pubs = [point_bytes(k.public_key())[1:] for k in keys]
-    items, expect = [], []
-    for i in range(n):
-        k = i % n_keys
-        digest = hashlib.sha256(b"bench-tx-%d" % i).digest()
-        # normalize to low-S: the provider enforces the reference's
-        # low-S rule (bccsp/sw/ecdsa.go:41-57) on raw OpenSSL output
-        sig = normalize_low_s(
-            keys[k].sign(digest, ec.ECDSA(Prehashed(hashes.SHA256()))))
-        bad = (i % 256) == 255          # sprinkle invalid signatures
-        if bad:
-            digest = hashlib.sha256(b"tampered-%d" % i).digest()
-        items.append(VerifyItem(digest, sig, pubs[k]))
-        expect.append(not bad)
-    return items, expect
+    return make_verify_items(n, n_keys=n_keys, invalid_every=256,
+                             seed=b"bench")
 
 
 def measure_sw(items, expect) -> float:

@@ -1,0 +1,178 @@
+"""Signature-policy compilation and batch-first evaluation.
+
+The L2 core (reference: common/cauthdsl/cauthdsl.go:24-92 `compile`,
+common/cauthdsl/policy.go:87 `EvaluateSignedData`, and
+common/policies/policy.go:365-403 `SignatureSetToValidIdentities`).
+
+The reference's evaluation shape is already ideal for a device batch:
+it *first* deduplicates identities and eagerly verifies every
+signature, *then* runs the combinatorial NOutOf/SignedBy walk over the
+set of validated identities.  Here that split is explicit and
+two-phase so a block validator can gather the signature sets of every
+policy evaluation in a block, fire ONE device batch-verify, and only
+then finish each policy decision host-side:
+
+    collector = BatchCollector()
+    pending = [pol.prepare(sds, collector) for (pol, sds) in work]
+    mask = verifier.verify_many(collector.items)   # one device call
+    results = [p.finish(mask) for p in pending]
+
+`CompiledPolicy.evaluate_signed_data` is the standalone convenience
+that does all three steps with a single verify call of its own.
+
+Host-side work stays host-side: identity deserialization, cert-chain
+validation, and principal matching are pointer-chasing x509 logic the
+MSP (with its second-chance caches) already handles; only the ECDSA
+math rides the batch.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from fabric_mod_tpu.bccsp.api import VerifyItem
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos.protoutil import SignedData
+
+
+class PolicyError(Exception):
+    pass
+
+
+class BatchCollector:
+    """Accumulates VerifyItems across many policy evaluations so they
+    can be verified in one device dispatch."""
+
+    def __init__(self):
+        self.items: List[VerifyItem] = []
+
+    def add(self, item: VerifyItem) -> int:
+        self.items.append(item)
+        return len(self.items) - 1
+
+
+class PendingEval:
+    """A policy decision waiting on the device verdict mask.
+
+    `slots` pairs each candidate identity with either the index of its
+    VerifyItem in the collector batch or a host-computed verdict (for
+    non-batchable curves).
+    """
+
+    def __init__(self, closure: Callable, idents: List,
+                 slots: List[tuple]):
+        self._closure = closure
+        self._idents = idents
+        self._slots = slots                 # (batch_idx | None, host_ok)
+
+    def finish(self, mask) -> bool:
+        """Resolve against the batch verdict mask -> policy verdict."""
+        valid = []
+        for ident, (bidx, host_ok) in zip(self._idents, self._slots):
+            ok = bool(mask[bidx]) if bidx is not None else host_ok
+            if ok:
+                valid.append(ident)
+        used = [False] * len(valid)
+        return self._closure(valid, used)
+
+
+def _compile(rule: m.SignaturePolicy,
+             principals: Sequence[m.MSPPrincipal],
+             msp_mgr) -> Callable:
+    """SignaturePolicy proto tree -> closure(idents, used) -> bool
+    (reference: cauthdsl.go:24-92 — same greedy used-flag semantics)."""
+    if rule.n_out_of is not None:
+        n = rule.n_out_of.n
+        subs = [_compile(r, principals, msp_mgr) for r in rule.n_out_of.rules]
+
+        def node(idents, used) -> bool:
+            # Trial/commit used-flag discipline, no early exit — exactly
+            # the reference's loop (cauthdsl.go:45-60): a failed child
+            # must not consume identities, and later children still run
+            # so the committed used-set matches the reference's.
+            verified = 0
+            for sub in subs:
+                trial = list(used)
+                if sub(idents, trial):
+                    verified += 1
+                    used[:] = trial
+            return verified >= n
+        return node
+
+    idx = rule.signed_by
+    if not 0 <= idx < len(principals):
+        raise PolicyError(f"identity index {idx} out of range")
+    principal = principals[idx]
+
+    def leaf(idents, used) -> bool:
+        for i, ident in enumerate(idents):
+            if used[i]:
+                continue
+            if msp_mgr.satisfies_principal(ident, principal):
+                used[i] = True
+                return True
+        return False
+    return leaf
+
+
+class CompiledPolicy:
+    """A compiled SignaturePolicyEnvelope bound to an MSP manager.
+
+    (reference: cauthdsl/policy.go `policy` + the provider at :25)
+    """
+
+    def __init__(self, envelope: m.SignaturePolicyEnvelope, msp_mgr):
+        if envelope.rule is None:
+            raise PolicyError("policy envelope has no rule")
+        self._msp_mgr = msp_mgr
+        self._closure = _compile(envelope.rule, envelope.identities, msp_mgr)
+        self.envelope = envelope
+
+    # -- phase 1: dedup + validate + stage verifies ----------------------
+    def prepare(self, signed_datas: Sequence[SignedData],
+                collector: BatchCollector) -> PendingEval:
+        """Dedup identities, drop undeserializable/invalid ones, stage
+        each survivor's signature check into `collector` (reference:
+        common/policies/policy.go:365-403, which dedups then verifies
+        every signature before the policy walk)."""
+        idents: List = []
+        slots: List[tuple] = []
+        seen = set()
+        for sd in signed_datas:
+            if sd.identity in seen:
+                continue                      # duplicate identity: skip
+            seen.add(sd.identity)
+            try:
+                ident = self._msp_mgr.deserialize_identity(sd.identity)
+            except Exception:
+                continue                      # unknown MSP / bad cert
+            try:
+                self._msp_mgr.validate(ident)
+            except Exception:
+                continue                      # expired/revoked/untrusted
+            item = ident.verify_item(sd.data, sd.signature)
+            if item is not None:
+                slots.append((collector.add(item), False))
+            else:                             # non-P256: host verify now
+                slots.append((None, ident.verify(sd.data, sd.signature)))
+            idents.append(ident)
+        return PendingEval(self._closure, idents, slots)
+
+    # -- phases 1+2+3 standalone -----------------------------------------
+    def evaluate_signed_data(self, signed_datas: Sequence[SignedData],
+                             verify_many: Optional[Callable] = None) -> bool:
+        """One-shot evaluation with its own single batch dispatch.
+        `verify_many` defaults to the MSP's CSP batch path."""
+        collector = BatchCollector()
+        pending = self.prepare(signed_datas, collector)
+        mask = (verify_many or self._default_verify)(collector.items)
+        return pending.finish(mask)
+
+    def _default_verify(self, items: Sequence[VerifyItem]):
+        csp = getattr(self._msp_mgr, "csp", None)
+        if csp is None:
+            # fall back to any MSP's provider — they share the process CSP
+            msps = self._msp_mgr.msps()
+            if not msps:
+                raise PolicyError("no MSPs configured")
+            csp = msps[0]._csp
+        return csp.verify_batch(items)

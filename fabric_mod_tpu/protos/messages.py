@@ -255,6 +255,34 @@ class SignaturePolicyEnvelope(Msg):
     identities: List[MSPPrincipal] = _f(default_factory=list)
 
 
+class PolicyType:
+    # common/policies.proto Policy.PolicyType
+    UNKNOWN = 0
+    SIGNATURE = 1
+    MSP = 2
+    IMPLICIT_META = 3
+
+
+@message
+class Policy(Msg):
+    FIELDS = ((1, "type", "i"), (2, "value", "b"))
+    type: int = 0
+    value: bytes = b""
+
+
+class ImplicitMetaRule:
+    ANY = 0
+    ALL = 1
+    MAJORITY = 2
+
+
+@message
+class ImplicitMetaPolicy(Msg):
+    FIELDS = ((1, "sub_policy", "s"), (2, "rule", "i"))
+    sub_policy: str = ""
+    rule: int = 0
+
+
 @message
 class ApplicationPolicy(Msg):
     # oneof: signature_policy or channel_config_policy_reference

@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: fixtures, logging, metrics, config."""

@@ -1,0 +1,68 @@
+"""Signature fixture generation — the single source for every harness.
+
+bench.py, the driver entry points (__graft_entry__), and tests all
+need "n real ECDSA-P256 signatures, some deliberately bad, plus the
+expected verdict mask".  Keeping one generator prevents the fixtures
+from drifting apart (e.g. one harness forgetting the low-S
+normalization the providers enforce).  This is the role the
+reference's generated test crypto plays (internal/cryptogen/ca/ca.go,
+common/crypto/tlsgen).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from fabric_mod_tpu.bccsp.api import VerifyItem
+from fabric_mod_tpu.bccsp.sw import SwCSP
+
+
+def make_verify_items(
+        n: int, n_keys: int = 8, invalid_every: Optional[int] = None,
+        seed: bytes = b"fixture") -> Tuple[List[VerifyItem], List[bool]]:
+    """n signed VerifyItems over `n_keys` keys; every `invalid_every`-th
+    item (1-based: i % invalid_every == invalid_every - 1) gets a
+    tampered digest.  Signatures come from the sw provider, so they are
+    low-S normalized exactly like production signing."""
+    csp = SwCSP()
+    keys = [csp.key_gen() for _ in range(min(n_keys, max(n, 1)))]
+    items, expect = [], []
+    for i in range(n):
+        k = keys[i % len(keys)]
+        digest = hashlib.sha256(seed + b"-%d" % i).digest()
+        sig = csp.sign(k, digest)
+        bad = invalid_every is not None and i % invalid_every == invalid_every - 1
+        if bad:
+            digest = hashlib.sha256(seed + b"-tampered-%d" % i).digest()
+        items.append(VerifyItem(digest, sig, k.public_xy()))
+        expect.append(not bad)
+    return items, expect
+
+
+def signature_arrays(
+        n: int, tamper_last: bool = True,
+        seed: bytes = b"fixture") -> Tuple[np.ndarray, ...]:
+    """The same fixtures as raw (n, 32) uint8 arrays (digest, r, s,
+    qx, qy) + expected mask — the shape ops/p256.marshal_inputs takes."""
+    from fabric_mod_tpu.bccsp.sw import decode_dss_signature
+
+    items, _ = make_verify_items(n, n_keys=1, seed=seed)
+    d = np.zeros((n, 32), np.uint8)
+    r = np.zeros((n, 32), np.uint8)
+    s = np.zeros((n, 32), np.uint8)
+    qx = np.zeros((n, 32), np.uint8)
+    qy = np.zeros((n, 32), np.uint8)
+    expect = np.ones(n, bool)
+    for i, it in enumerate(items):
+        ri, si = decode_dss_signature(it.signature)
+        d[i] = np.frombuffer(it.digest, np.uint8)
+        r[i] = np.frombuffer(ri.to_bytes(32, "big"), np.uint8)
+        s[i] = np.frombuffer(si.to_bytes(32, "big"), np.uint8)
+        qx[i] = np.frombuffer(it.public_xy[:32], np.uint8)
+        qy[i] = np.frombuffer(it.public_xy[32:], np.uint8)
+    if tamper_last and n:
+        d[n - 1, 0] ^= 0xFF
+        expect[n - 1] = False
+    return d, r, s, qx, qy, expect

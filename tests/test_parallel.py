@@ -2,27 +2,14 @@
 (conftest forces JAX_PLATFORMS=cpu + xla_force_host_platform_device_count=8),
 mirroring how the reference tests multi-node logic with in-process
 fakes rather than a real cluster (SURVEY.md §4)."""
-import hashlib
-
 import numpy as np
 import pytest
 
-from fabric_mod_tpu.bccsp.api import VerifyItem
-from fabric_mod_tpu.bccsp.sw import SwCSP, point_bytes
+from fabric_mod_tpu.utils.fixtures import make_verify_items
 
 
 def _items(n):
-    csp = SwCSP()
-    items, expect = [], []
-    for i in range(n):
-        k = csp.key_gen()
-        d = hashlib.sha256(b"m%d" % i).digest()
-        sig = csp.sign(k, d)
-        if i % 3 == 2:                    # tamper every third
-            d = hashlib.sha256(b"x%d" % i).digest()
-        items.append(VerifyItem(d, sig, k.public_xy()))
-        expect.append(i % 3 != 2)
-    return items, expect
+    return make_verify_items(n, invalid_every=3)   # tamper every third
 
 
 def test_mesh_construction():

@@ -1,0 +1,273 @@
+"""Policy engine tests: DSL parsing, NOutOf evaluation semantics,
+dedup + eager batch verification, implicit meta policies, application
+policies.  Negative coverage mirrors the reference's cauthdsl tests
+(under-threshold, duplicate identities, invalid signatures)."""
+import hashlib
+
+import pytest
+
+from fabric_mod_tpu.bccsp.sw import SwCSP
+from fabric_mod_tpu.msp import ca as calib
+from fabric_mod_tpu.msp.identities import SigningIdentity
+from fabric_mod_tpu.msp.mspimpl import Msp, MspManager
+from fabric_mod_tpu.policy import (
+    ApplicationPolicyEvaluator, BatchCollector, CompiledPolicy, DslError,
+    PolicyManager, from_string)
+from fabric_mod_tpu.policy.manager import ImplicitMetaPolicyObj
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos.protoutil import SignedData
+
+
+@pytest.fixture(scope="module")
+def world():
+    """Three orgs, one signer each + an extra Org1 signer."""
+    csp = SwCSP()
+    orgs = {}
+    msps = []
+    for name in ("Org1", "Org2", "Org3"):
+        ca = calib.CA(f"ca.{name.lower()}", name)
+        msp = Msp(name, csp, [ca.cert])
+        msps.append(msp)
+        def mk(cn, ous, _ca=ca, _name=name):
+            cert, key = _ca.issue(cn, _name, ous=ous)
+            return SigningIdentity(_name, cert,
+                                   calib.key_pem(key), csp)
+        orgs[name] = dict(
+            ca=ca, msp=msp,
+            peer=mk(f"peer0.{name.lower()}", ["peer"]),
+            admin=mk(f"admin@{name.lower()}", ["admin"]))
+    ca1 = orgs["Org1"]["ca"]
+    cert, key = ca1.issue("peer1.org1", "Org1", ous=["peer"])
+    orgs["Org1"]["peer2"] = SigningIdentity(
+        "Org1", cert, calib.key_pem(key), csp)
+    mgr = MspManager(msps)
+    return dict(csp=csp, orgs=orgs, mgr=mgr)
+
+
+def _sd(ident, data: bytes) -> SignedData:
+    return SignedData(data=data, identity=ident.serialize(),
+                      signature=ident.sign_message(data))
+
+
+# --- DSL parser -------------------------------------------------------------
+
+def test_dsl_and_or_outof():
+    env = from_string("AND('Org1.member', 'Org2.member')")
+    assert env.rule.n_out_of.n == 2
+    assert len(env.identities) == 2
+    env = from_string("OR('Org1.member', 'Org2.member')")
+    assert env.rule.n_out_of.n == 1
+    env = from_string(
+        "OutOf(2, 'Org1.peer', 'Org2.peer', 'Org3.peer')")
+    assert env.rule.n_out_of.n == 2
+    assert len(env.identities) == 3
+
+
+def test_dsl_nested_and_dedup():
+    env = from_string(
+        "AND('Org1.member', OR('Org2.admin', 'Org1.member'))")
+    # Org1.member used twice -> one identities entry
+    assert len(env.identities) == 2
+    inner = env.rule.n_out_of.rules[1]
+    assert inner.n_out_of.rules[1].signed_by == 0   # dedup'd index
+
+
+@pytest.mark.parametrize("bad", [
+    "AND('Org1.member'", "XOR('a.b')", "AND(Org1.member)",
+    "OutOf(5, 'Org1.member')", "'Org1.bogusrole'", "''",
+    "AND('Org1.member') trailing",
+])
+def test_dsl_rejects(bad):
+    with pytest.raises(DslError):
+        from_string(bad)
+
+
+# --- evaluation -------------------------------------------------------------
+
+def _compiled(world, dsl):
+    return CompiledPolicy(from_string(dsl), world["mgr"])
+
+
+def test_two_of_three_endorsement(world):
+    pol = _compiled(world, "OutOf(2, 'Org1.peer', 'Org2.peer', 'Org3.peer')")
+    o = world["orgs"]
+    data = b"proposal-response-payload"
+    assert pol.evaluate_signed_data(
+        [_sd(o["Org1"]["peer"], data), _sd(o["Org2"]["peer"], data)])
+    assert pol.evaluate_signed_data(
+        [_sd(o["Org2"]["peer"], data), _sd(o["Org3"]["peer"], data)])
+    # under threshold
+    assert not pol.evaluate_signed_data([_sd(o["Org1"]["peer"], data)])
+    # wrong role
+    assert not pol.evaluate_signed_data(
+        [_sd(o["Org1"]["peer"], data), _sd(o["Org2"]["admin"], data)])
+
+
+def test_duplicate_identity_not_double_counted(world):
+    pol = _compiled(world, "AND('Org1.peer', 'Org1.peer')")
+    o = world["orgs"]
+    data = b"d"
+    sd = _sd(o["Org1"]["peer"], data)
+    # same identity twice: dedup leaves one -> AND of two fails
+    assert not pol.evaluate_signed_data([sd, sd])
+    # two *distinct* Org1 peers satisfy it
+    assert pol.evaluate_signed_data(
+        [sd, _sd(o["Org1"]["peer2"], data)])
+
+
+def test_invalid_signature_rejected(world):
+    pol = _compiled(world, "OR('Org1.peer')")
+    o = world["orgs"]
+    good = _sd(o["Org1"]["peer"], b"data")
+    bad = SignedData(data=b"data", identity=good.identity,
+                     signature=good.signature[:-4] + b"\x00\x00\x00\x00")
+    assert not pol.evaluate_signed_data([bad])
+    assert pol.evaluate_signed_data([good])
+
+
+def test_foreign_identity_skipped(world):
+    """An identity from an MSP the channel doesn't know is dropped
+    during the dedup/validate phase, not an error."""
+    pol = _compiled(world, "OR('Org1.peer')")
+    evil_ca = calib.CA("ca.evil", "Evil")
+    cert, key = evil_ca.issue("spy", "Evil", ous=["peer"])
+    spy = SigningIdentity("EvilMSP", cert, calib.key_pem(key), world["csp"])
+    assert not pol.evaluate_signed_data([_sd(spy, b"d")])
+
+
+def test_single_batch_dispatch_for_many_policies(world):
+    """The whole point: N policy evaluations -> ONE verify call."""
+    o = world["orgs"]
+    calls = []
+
+    def counting_verify(items):
+        calls.append(len(items))
+        return SwCSP().verify_batch(items)
+
+    pols = [
+        _compiled(world, "OutOf(2, 'Org1.peer', 'Org2.peer', 'Org3.peer')"),
+        _compiled(world, "AND('Org1.admin', 'Org2.admin')"),
+        _compiled(world, "OR('Org3.peer')"),
+    ]
+    work = [
+        [_sd(o["Org1"]["peer"], b"t0"), _sd(o["Org2"]["peer"], b"t0")],
+        [_sd(o["Org1"]["admin"], b"t1"), _sd(o["Org2"]["admin"], b"t1")],
+        [_sd(o["Org3"]["peer"], b"t2")],
+    ]
+    collector = BatchCollector()
+    pending = [p.prepare(sds, collector) for p, sds in zip(pols, work)]
+    mask = counting_verify(collector.items)
+    results = [pd.finish(mask) for pd in pending]
+    assert results == [True, True, True]
+    assert calls == [5]                      # one dispatch, 5 signatures
+
+
+def test_nested_noutof_trial_commit_semantics(world):
+    """A failed inner OutOf branch must not consume identities
+    (reference cauthdsl.go trial/commit loop)."""
+    o = world["orgs"]
+    # OR(AND(Org1.peer, Org2.peer), Org1.peer): with only Org1's peer
+    # present the AND fails but must release Org1.peer for the second
+    # branch.
+    pol = _compiled(
+        world, "OR(AND('Org1.peer', 'Org2.peer'), 'Org1.peer')")
+    assert pol.evaluate_signed_data([_sd(o["Org1"]["peer"], b"d")])
+
+
+# --- implicit meta + manager ------------------------------------------------
+
+def _org_writers(world):
+    return {
+        name: CompiledPolicy(from_string(f"OR('{name}.member')"),
+                             world["mgr"])
+        for name in ("Org1", "Org2", "Org3")
+    }
+
+
+def test_implicit_meta_majority(world):
+    o = world["orgs"]
+    subs = list(_org_writers(world).values())
+    maj = ImplicitMetaPolicyObj(subs, m.ImplicitMetaRule.MAJORITY)
+    assert maj.threshold == 2
+    data = b"config-update"
+    assert maj.evaluate_signed_data(
+        [_sd(o["Org1"]["peer"], data), _sd(o["Org2"]["peer"], data)])
+    assert not maj.evaluate_signed_data([_sd(o["Org3"]["peer"], data)])
+    any_ = ImplicitMetaPolicyObj(subs, m.ImplicitMetaRule.ANY)
+    assert any_.evaluate_signed_data([_sd(o["Org3"]["peer"], data)])
+    all_ = ImplicitMetaPolicyObj(subs, m.ImplicitMetaRule.ALL)
+    assert not all_.evaluate_signed_data(
+        [_sd(o["Org1"]["peer"], data), _sd(o["Org2"]["peer"], data)])
+
+
+def test_empty_implicit_meta_never_passes(world):
+    """ANY over zero sub-policies must fail closed (threshold pinned
+    at 1 like the reference), never authorize everything."""
+    o = world["orgs"]
+    empty_any = ImplicitMetaPolicyObj([], m.ImplicitMetaRule.ANY)
+    assert empty_any.threshold == 1
+    from fabric_mod_tpu.policy import BatchCollector
+    col = BatchCollector()
+    pending = empty_any.prepare([_sd(o["Org1"]["peer"], b"x")], col)
+    assert pending.finish([]) is False
+
+
+def test_channel_policy_reference_not_stale(world):
+    """Replacing a named channel policy must take effect on the next
+    evaluation (the reference re-resolves per call)."""
+    o = world["orgs"]
+    app = PolicyManager("Application", policies={
+        "Endorsement": _compiled(world, "OR('Org1.peer')")})
+    root = PolicyManager("Channel")
+    root.add_sub_manager(app)
+    ref = m.ApplicationPolicy(
+        channel_config_policy_reference="/Channel/Application/Endorsement")
+    ev = ApplicationPolicyEvaluator(world["mgr"], root)
+    sds = [_sd(o["Org1"]["peer"], b"d")]
+    assert ev.evaluate(ref.encode(), sds)
+    # config update tightens the policy to 2-of-2
+    app.add_policy("Endorsement",
+                   _compiled(world, "AND('Org1.peer', 'Org2.peer')"))
+    assert not ev.evaluate(ref.encode(), sds)
+
+
+def test_policy_manager_paths(world):
+    writers = _org_writers(world)
+    app = PolicyManager("Application")
+    for name, pol in writers.items():
+        org_mgr = PolicyManager(name, policies={"Writers": pol})
+        app.add_sub_manager(org_mgr)
+    app.resolve_implicit_meta("Writers", m.ImplicitMetaPolicy(
+        sub_policy="Writers", rule=m.ImplicitMetaRule.ANY))
+    root = PolicyManager("Channel")
+    root.add_sub_manager(app)
+    pol = root.get_policy("/Channel/Application/Writers")
+    assert pol is not None
+    o = world["orgs"]
+    assert pol.evaluate_signed_data([_sd(o["Org2"]["peer"], b"x")])
+    assert root.get_policy("/Channel/Application/Nope") is None
+    assert root.get_policy("/Other/Thing") is None
+    assert app.get_policy("Writers") is pol
+
+
+def test_application_policy_evaluator(world):
+    o = world["orgs"]
+    inline = m.ApplicationPolicy(
+        signature_policy=from_string("AND('Org1.peer', 'Org2.peer')"))
+    ev = ApplicationPolicyEvaluator(world["mgr"])
+    data = b"prp||endorser"
+    assert ev.evaluate(inline.encode(), [
+        _sd(o["Org1"]["peer"], data), _sd(o["Org2"]["peer"], data)])
+    assert not ev.evaluate(inline.encode(), [_sd(o["Org1"]["peer"], data)])
+
+    # channel policy reference
+    app = PolicyManager("Application", policies={
+        "Endorsement": CompiledPolicy(
+            from_string("OR('Org3.peer')"), world["mgr"])})
+    root = PolicyManager("Channel")
+    root.add_sub_manager(app)
+    ref = m.ApplicationPolicy(
+        channel_config_policy_reference="/Channel/Application/Endorsement")
+    ev2 = ApplicationPolicyEvaluator(world["mgr"], root)
+    assert ev2.evaluate(ref.encode(), [_sd(o["Org3"]["peer"], b"z")])
+    assert not ev2.evaluate(ref.encode(), [_sd(o["Org1"]["peer"], b"z")])
