@@ -1,0 +1,15 @@
+"""L3 ledger: append-only block store, versioned state DB with MVCC,
+history, simulation, and crash recovery.
+
+The commit path is SURVEY.md §3.3's serialization point: blocks
+arrive signature/policy-validated (flags from the device batch), MVCC
+runs serially, state/history are derived — and re-derivable — from
+the block store (the ledger *is* the checkpoint, §5.4).
+"""
+from fabric_mod_tpu.ledger.blkstorage import BlockStore, BlockStoreError  # noqa: F401
+from fabric_mod_tpu.ledger.statedb import UpdateBatch, VersionedDB  # noqa: F401
+from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder, parse_tx_rwset  # noqa: F401
+from fabric_mod_tpu.ledger.mvcc import validate_and_prepare_batch  # noqa: F401
+from fabric_mod_tpu.ledger.kvledger import (  # noqa: F401
+    HistoryDB, KvLedger, LedgerError, LedgerManager, QueryExecutor,
+    TxSimulator, tx_rwset_from_envelope)

@@ -1,0 +1,177 @@
+"""Append-only block file store with index and crash recovery.
+
+(reference: common/ledger/blkstorage/blockfile_mgr.go — rolling block
+files with length-prefixed records, a leveldb index by number/hash/
+txid, and checkpoint reconstruction by scanning the last file;
+blockfile_helper.go crops torn writes.)
+
+Record format per block:  u32 payload_len ‖ payload ‖ sha256(payload)
+— the trailing digest makes torn tail writes detectable without a
+separate checkpoint file; recovery truncates the file at the last
+whole record.  The in-memory index (number -> (file, offset),
+txid -> (number, txpos)) is rebuilt by scanning on open, which doubles
+as the integrity pass.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+
+_MAX_FILE = 64 * 1024 * 1024
+
+
+class BlockStoreError(Exception):
+    pass
+
+
+def _tx_ids(block: m.Block) -> List[str]:
+    ids = []
+    for env in protoutil.get_envelopes(block):
+        try:
+            ch = protoutil.envelope_channel_header(env)
+            ids.append(ch.tx_id)
+        except Exception:
+            ids.append("")
+    return ids
+
+
+class BlockStore:
+    """One channel's block files under `dir_path`."""
+
+    def __init__(self, dir_path: str):
+        self.dir = dir_path
+        os.makedirs(dir_path, exist_ok=True)
+        self._by_num: Dict[int, Tuple[int, int]] = {}    # num -> (file, off)
+        self._by_txid: Dict[str, Tuple[int, int]] = {}   # txid -> (num, pos)
+        self._height = 0
+        self._last_hash = b""
+        self._cur_file = 0
+        self._recover()
+        self._fh = open(self._file_path(self._cur_file), "ab")
+
+    # -- file layout -----------------------------------------------------
+    def _file_path(self, n: int) -> str:
+        return os.path.join(self.dir, f"blockfile_{n:06d}")
+
+    def _files(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith("blockfile_"):
+                out.append(int(name.split("_")[1]))
+        return sorted(out)
+
+    # -- recovery scan ---------------------------------------------------
+    def _recover(self) -> None:
+        files = self._files()
+        if not files:
+            return
+        stopped_at = files[-1]
+        for fno in files:
+            path = self._file_path(fno)
+            raw = open(path, "rb").read()
+            pos = 0
+            good_end = 0
+            while pos + 4 <= len(raw):
+                (ln,) = struct.unpack_from("<I", raw, pos)
+                end = pos + 4 + ln + 32
+                if end > len(raw):
+                    break                       # torn tail
+                payload = raw[pos + 4:pos + 4 + ln]
+                digest = raw[pos + 4 + ln:end]
+                if hashlib.sha256(payload).digest() != digest:
+                    break                       # corruption: crop here
+                block = m.Block.decode(payload)
+                num = block.header.number
+                if num != self._height:
+                    raise BlockStoreError(
+                        f"block {num} out of order (height {self._height})")
+                self._index_block(block, fno, pos)
+                self._height = num + 1
+                self._last_hash = protoutil.block_header_hash(block.header)
+                pos = end
+                good_end = end
+            if good_end < len(raw):             # crop torn/corrupt tail
+                with open(path, "r+b") as f:
+                    f.truncate(good_end)
+                stopped_at = fno
+                break
+        # anything after a cropped file cannot be contiguous: drop it
+        for fno in files:
+            if fno > stopped_at:
+                os.remove(self._file_path(fno))
+        self._cur_file = stopped_at
+
+    def _index_block(self, block: m.Block, fno: int, off: int) -> None:
+        num = block.header.number
+        self._by_num[num] = (fno, off)
+        for pos, txid in enumerate(_tx_ids(block)):
+            if txid and txid not in self._by_txid:
+                self._by_txid[txid] = (num, pos)
+
+    # -- writes ----------------------------------------------------------
+    def add_block(self, block: m.Block) -> None:
+        num = block.header.number
+        if num != self._height:
+            raise BlockStoreError(
+                f"expected block {self._height}, got {num}")
+        if self._height > 0 and block.header.previous_hash != self._last_hash:
+            raise BlockStoreError(f"block {num} previous_hash mismatch")
+        payload = block.encode()
+        if self._fh.tell() > _MAX_FILE:
+            self._fh.close()
+            self._cur_file += 1
+            self._fh = open(self._file_path(self._cur_file), "ab")
+        off = self._fh.tell()
+        self._fh.write(struct.pack("<I", len(payload)))
+        self._fh.write(payload)
+        self._fh.write(hashlib.sha256(payload).digest())
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._index_block(block, self._cur_file, off)
+        self._height = num + 1
+        self._last_hash = protoutil.block_header_hash(block.header)
+
+    # -- reads -----------------------------------------------------------
+    @property
+    def height(self) -> int:
+        return self._height
+
+    @property
+    def last_block_hash(self) -> bytes:
+        return self._last_hash
+
+    def get_block_by_number(self, num: int) -> Optional[m.Block]:
+        loc = self._by_num.get(num)
+        if loc is None:
+            return None
+        fno, off = loc
+        with open(self._file_path(fno), "rb") as f:
+            f.seek(off)
+            (ln,) = struct.unpack("<I", f.read(4))
+            return m.Block.decode(f.read(ln))
+
+    def get_block_by_txid(self, txid: str) -> Optional[m.Block]:
+        loc = self._by_txid.get(txid)
+        return self.get_block_by_number(loc[0]) if loc else None
+
+    def get_tx_loc(self, txid: str) -> Optional[Tuple[int, int]]:
+        return self._by_txid.get(txid)
+
+    def get_tx_by_id(self, txid: str) -> Optional[m.Envelope]:
+        loc = self._by_txid.get(txid)
+        if loc is None:
+            return None
+        block = self.get_block_by_number(loc[0])
+        return protoutil.get_envelopes(block)[loc[1]]
+
+    def iter_blocks(self, start: int = 0) -> Iterator[m.Block]:
+        for num in range(start, self._height):
+            yield self.get_block_by_number(num)
+
+    def close(self) -> None:
+        self._fh.close()

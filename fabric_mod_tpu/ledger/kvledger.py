@@ -1,0 +1,279 @@
+"""The kv ledger: block store + versioned state + history, with
+simulation, MVCC commit, and crash recovery.
+
+(reference: core/ledger/kvledger/kv_ledger.go — `CommitLegacy` at
+:457-541, recovery at :228-341 `recoverDBs`; the lock-based tx manager
+in txmgmt/txmgr/lockbased_txmgr.go; query executors in
+query_executor.go; history in kvledger/history/db.go.)
+
+Commit pipeline stage order matches the reference: MVCC validate ->
+append block (+flags in metadata) -> apply state batch -> history ->
+snapshot/savepoint.  State and history are derivable from the block
+store, so on open any gap between the state savepoint and the block
+height is replayed — the ledger *is* the checkpoint (SURVEY.md §5.4).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from fabric_mod_tpu.ledger.blkstorage import BlockStore
+from fabric_mod_tpu.ledger.mvcc import validate_and_prepare_batch
+from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder, parse_tx_rwset
+from fabric_mod_tpu.ledger.statedb import UpdateBatch, VersionedDB
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+
+Version = Tuple[int, int]
+
+
+class LedgerError(Exception):
+    pass
+
+
+class QueryExecutor:
+    """Read-only state access (reference: query_executor.go)."""
+
+    def __init__(self, db: VersionedDB):
+        self._db = db
+
+    def get_state(self, ns: str, key: str) -> Optional[bytes]:
+        got = self._db.get_state(ns, key)
+        return got[0] if got else None
+
+    def get_state_range(self, ns: str, start: str, end: str):
+        for key, value, _ in self._db.get_state_range(ns, start, end):
+            yield key, value
+
+
+class TxSimulator(QueryExecutor):
+    """Records reads/writes into an RWSetBuilder
+    (reference: lockbased_txmgr.go NewTxSimulator + rwset_builder)."""
+
+    def __init__(self, db: VersionedDB, txid: str):
+        super().__init__(db)
+        self.txid = txid
+        self._rw = RWSetBuilder()
+        self._writes: Dict[Tuple[str, str], Optional[bytes]] = {}
+
+    def get_state(self, ns: str, key: str) -> Optional[bytes]:
+        if (ns, key) in self._writes:       # read-your-writes
+            return self._writes[(ns, key)]
+        got = self._db.get_state(ns, key)
+        self._rw.add_read(ns, key, got[1] if got else None)
+        return got[0] if got else None
+
+    def get_state_range(self, ns: str, start: str, end: str):
+        results = []
+        out = []
+        for key, value, ver in self._db.get_state_range(ns, start, end):
+            results.append((key, ver))
+            out.append((key, value))
+        self._rw.add_range_query(ns, start, end, True, results)
+        return iter(out)
+
+    def set_state(self, ns: str, key: str, value: bytes) -> None:
+        self._writes[(ns, key)] = value
+        self._rw.add_write(ns, key, value)
+
+    def delete_state(self, ns: str, key: str) -> None:
+        self._writes[(ns, key)] = None
+        self._rw.add_write(ns, key, None)
+
+    def done(self) -> m.TxReadWriteSet:
+        return self._rw.build()
+
+
+class HistoryDB:
+    """(ns, key) -> [(block, tx), ...] — rebuildable from blocks
+    (reference: kvledger/history/db.go)."""
+
+    def __init__(self):
+        self._hist: Dict[Tuple[str, str], List[Version]] = {}
+
+    def commit(self, block_num: int,
+               tx_writes: List[Tuple[int, str, str]]) -> None:
+        for tx_num, ns, key in tx_writes:
+            self._hist.setdefault((ns, key), []).append((block_num, tx_num))
+
+    def get_history_for_key(self, ns: str, key: str) -> List[Version]:
+        return list(self._hist.get((ns, key), []))
+
+
+def tx_rwset_from_envelope(env: m.Envelope) -> Optional[m.TxReadWriteSet]:
+    """Envelope -> TxReadWriteSet of its (first) endorser action, or
+    None when absent/malformed (reference: rwsetutil on the
+    ChaincodeAction.results path)."""
+    try:
+        payload = protoutil.unmarshal_envelope_payload(env)
+        tx = protoutil.extract_endorser_tx(payload)
+        cca, _prp, _ends = protoutil.tx_rwset_and_endorsements(tx.actions[0])
+        return m.TxReadWriteSet.decode(cca.results)
+    except Exception:
+        return None
+
+
+class KvLedger:
+    """One channel's ledger (reference: kv_ledger.go kvLedger)."""
+
+    SNAPSHOT_EVERY = 64
+
+    def __init__(self, ledger_dir: str, ledger_id: str = "ch"):
+        self.ledger_id = ledger_id
+        self.dir = ledger_dir
+        os.makedirs(ledger_dir, exist_ok=True)
+        self._lock = threading.RLock()
+        self.blockstore = BlockStore(os.path.join(ledger_dir, "chains"))
+        self._state_path = os.path.join(ledger_dir, "state.snap")
+        self.state = VersionedDB.load(self._state_path)
+        self.history = HistoryDB()
+        self._recover()
+
+    # -- recovery --------------------------------------------------------
+    def _recover(self) -> None:
+        """Replay blocks past the state savepoint; rebuild history
+        entirely (reference: kv_ledger.go:239
+        syncStateAndHistoryDBWithBlockstore)."""
+        height = self.blockstore.height
+        if self.state.savepoint >= height:
+            # state snapshot ran ahead of a cropped block store: state
+            # must be rebuilt from genesis
+            self.state = VersionedDB()
+        for block in self.blockstore.iter_blocks(0):
+            num = block.header.number
+            replay_state = num > self.state.savepoint
+            self._apply_block_effects(block, replay_state=replay_state)
+
+    def _apply_block_effects(self, block: m.Block,
+                             replay_state: bool) -> None:
+        """Re-derive state/history updates of a committed block from
+        its stored txflags (no re-validation on replay)."""
+        flags = protoutil.block_txflags(block)
+        num = block.header.number
+        batch = UpdateBatch()
+        hist: List[Tuple[int, str, str]] = []
+        for tx_num, env in enumerate(protoutil.get_envelopes(block)):
+            if flags[tx_num] != m.TxValidationCode.VALID:
+                continue
+            rwset = tx_rwset_from_envelope(env)
+            if rwset is None:
+                continue
+            for ns, kv in parse_tx_rwset(rwset):
+                for w in kv.writes:
+                    if w.is_delete:
+                        batch.delete(ns, w.key, (num, tx_num))
+                    else:
+                        batch.put(ns, w.key, w.value, (num, tx_num))
+                    hist.append((tx_num, ns, w.key))
+        if replay_state:
+            self.state.apply_updates(batch, num)
+        self.history.commit(num, hist)
+
+    # -- simulation ------------------------------------------------------
+    def new_tx_simulator(self, txid: str) -> TxSimulator:
+        return TxSimulator(self.state, txid)
+
+    def new_query_executor(self) -> QueryExecutor:
+        return QueryExecutor(self.state)
+
+    # -- commit ----------------------------------------------------------
+    def commit_block(self, block: m.Block,
+                     incoming_flags: Optional[List[int]] = None) -> List[int]:
+        """MVCC-validate + commit a block whose signature/policy
+        verdicts are `incoming_flags` (defaults to the flags already in
+        the block metadata, e.g. from the validator).  Returns final
+        flags.  (reference: kv_ledger.go:457 CommitLegacy)"""
+        with self._lock:
+            num = block.header.number
+            if num != self.blockstore.height:
+                raise LedgerError(
+                    f"commit out of order: {num} at height "
+                    f"{self.blockstore.height}")
+            envs = protoutil.get_envelopes(block)
+            if incoming_flags is None:
+                incoming_flags = list(protoutil.block_txflags(block))
+                if len(incoming_flags) != len(envs):
+                    incoming_flags = [m.TxValidationCode.VALID] * len(envs)
+            elif len(incoming_flags) != len(envs):
+                raise LedgerError(
+                    f"flags length {len(incoming_flags)} != "
+                    f"{len(envs)} txs")
+            txs = []
+            for env, flag in zip(envs, incoming_flags):
+                try:
+                    ch = protoutil.envelope_channel_header(env)
+                    txid = ch.tx_id
+                except Exception:
+                    txs.append(("", None, m.TxValidationCode.BAD_PAYLOAD))
+                    continue
+                txs.append((txid, tx_rwset_from_envelope(env), flag))
+            flags, batch = validate_and_prepare_batch(txs, self.state, num)
+            protoutil.set_block_txflags(block, bytes(flags))
+            self.blockstore.add_block(block)
+            self.state.apply_updates(batch, num)
+            # History records every valid tx's writes (not the deduped
+            # batch) so commit and recovery replay agree.
+            hist: List[Tuple[int, str, str]] = []
+            for tx_num, ((txid, rwset, _f), flag) in enumerate(
+                    zip(txs, flags)):
+                if flag != m.TxValidationCode.VALID or rwset is None:
+                    continue
+                for ns, kv in parse_tx_rwset(rwset):
+                    for w in kv.writes:
+                        hist.append((tx_num, ns, w.key))
+            self.history.commit(num, hist)
+            if (num + 1) % self.SNAPSHOT_EVERY == 0:
+                self.state.snapshot(self._state_path)
+            return flags
+
+    # -- queries ---------------------------------------------------------
+    @property
+    def height(self) -> int:
+        return self.blockstore.height
+
+    def get_block_by_number(self, num: int) -> Optional[m.Block]:
+        return self.blockstore.get_block_by_number(num)
+
+    def get_transaction_by_id(self, txid: str) -> Optional[m.ProcessedTransaction]:
+        loc = self.blockstore.get_tx_loc(txid)
+        if loc is None:
+            return None
+        block = self.blockstore.get_block_by_number(loc[0])
+        flags = protoutil.block_txflags(block)
+        return m.ProcessedTransaction(
+            transaction_envelope=protoutil.get_envelopes(block)[loc[1]],
+            validation_code=flags[loc[1]])
+
+    def tx_id_exists(self, txid: str) -> bool:
+        return self.blockstore.get_tx_loc(txid) is not None
+
+    def close(self) -> None:
+        with self._lock:
+            self.state.snapshot(self._state_path)
+            self.blockstore.close()
+
+
+class LedgerManager:
+    """Open/create ledgers by id (reference: ledgermgmt/ledger_mgmt.go)."""
+
+    def __init__(self, root_dir: str):
+        self.root = root_dir
+        os.makedirs(root_dir, exist_ok=True)
+        self._ledgers: Dict[str, KvLedger] = {}
+
+    def create_or_open(self, ledger_id: str) -> KvLedger:
+        if ledger_id not in self._ledgers:
+            self._ledgers[ledger_id] = KvLedger(
+                os.path.join(self.root, ledger_id), ledger_id)
+        return self._ledgers[ledger_id]
+
+    def ledger_ids(self) -> List[str]:
+        existing = set(self._ledgers)
+        if os.path.isdir(self.root):
+            existing.update(os.listdir(self.root))
+        return sorted(existing)
+
+    def close(self) -> None:
+        for led in self._ledgers.values():
+            led.close()

@@ -40,14 +40,24 @@ class PolicyError(Exception):
 
 class BatchCollector:
     """Accumulates VerifyItems across many policy evaluations so they
-    can be verified in one device dispatch."""
+    can be verified in one device dispatch.  Identical work items
+    (same digest, signature, key) dedup to one batch slot — meta
+    policies hand the same signature set to every sub-policy, and
+    re-verifying it per sub-policy would multiply the device batch."""
 
     def __init__(self):
         self.items: List[VerifyItem] = []
+        self._index: dict = {}
 
     def add(self, item: VerifyItem) -> int:
+        key = (item.digest, item.signature, item.public_xy)
+        got = self._index.get(key)
+        if got is not None:
+            return got
         self.items.append(item)
-        return len(self.items) - 1
+        idx = len(self.items) - 1
+        self._index[key] = idx
+        return idx
 
 
 class PendingEval:

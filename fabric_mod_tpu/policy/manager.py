@@ -45,8 +45,6 @@ class ImplicitMetaPolicyObj:
         self._subs = list(sub_policies)
         n = len(self._subs)
         if rule == m.ImplicitMetaRule.ANY:
-            # pinned at 1 like the reference: an empty meta policy can
-            # never pass (threshold 0 would be fail-open)
             self.threshold = 1
         elif rule == m.ImplicitMetaRule.ALL:
             self.threshold = n
@@ -54,6 +52,10 @@ class ImplicitMetaPolicyObj:
             self.threshold = n // 2 + 1
         else:
             raise PolicyError(f"unknown implicit meta rule {rule}")
+        if n == 0:
+            # pinned like the reference: a meta policy over zero
+            # sub-policies can never pass (threshold 0 = fail-open)
+            self.threshold = 1
 
     def prepare(self, signed_datas: Sequence[SignedData],
                 collector: BatchCollector):

@@ -1,0 +1,305 @@
+"""Ledger tests: versioned state DB + snapshots, block store append/
+recovery/torn-tail cropping, MVCC conflicts (incl. phantoms), and the
+kv ledger commit/simulate/replay cycle — mirroring the reference's
+txmgmt validation and kvledger recovery suites."""
+import os
+
+import pytest
+
+from fabric_mod_tpu.ledger import (
+    BlockStore, BlockStoreError, KvLedger, LedgerManager, RWSetBuilder,
+    UpdateBatch, VersionedDB, validate_and_prepare_batch)
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+
+
+def _endorser_env(txid: str, rwset: m.TxReadWriteSet,
+                  channel: str = "ch") -> m.Envelope:
+    cca = m.ChaincodeAction(results=rwset.encode())
+    prp = m.ProposalResponsePayload(proposal_hash=b"\x01" * 32,
+                                    extension=cca.encode())
+    cea = m.ChaincodeEndorsedAction(
+        proposal_response_payload=prp.encode(), endorsements=[])
+    cap = m.ChaincodeActionPayload(action=cea)
+    tx = m.Transaction(actions=[m.TransactionAction(payload=cap.encode())])
+    ch = protoutil.make_channel_header(
+        m.HeaderType.ENDORSER_TRANSACTION, channel, tx_id=txid)
+    sh = protoutil.make_signature_header(b"creator", b"nonce-" + txid.encode())
+    payload = protoutil.make_payload(ch, sh, tx.encode())
+    return m.Envelope(payload=payload.encode(), signature=b"")
+
+
+def _rw(reads=(), writes=(), ranges=()) -> m.TxReadWriteSet:
+    b = RWSetBuilder()
+    for ns, key, ver in reads:
+        b.add_read(ns, key, ver)
+    for ns, key, val in writes:
+        b.add_write(ns, key, val)
+    for ns, start, end, results in ranges:
+        b.add_range_query(ns, start, end, True, results)
+    return b.build()
+
+
+def _block(num: int, prev: bytes, envs) -> m.Block:
+    blk = protoutil.new_block(num, prev, envs)
+    protoutil.set_block_txflags(
+        blk, bytes([m.TxValidationCode.VALID] * len(envs)))
+    return blk
+
+
+# --- statedb ---------------------------------------------------------------
+
+def test_statedb_basic_and_range():
+    db = VersionedDB()
+    batch = UpdateBatch()
+    for i in range(5):
+        batch.put("cc", f"k{i}", b"v%d" % i, (1, i))
+    batch.put("other", "x", b"y", (1, 9))
+    db.apply_updates(batch, 1)
+    assert db.get_state("cc", "k2") == (b"v2", (1, 2))
+    assert db.get_state("cc", "nope") is None
+    got = list(db.get_state_range("cc", "k1", "k4"))
+    assert [k for k, _, _ in got] == ["k1", "k2", "k3"]
+    # unbounded end
+    assert len(list(db.get_state_range("cc", "k0", ""))) == 5
+    # delete removes from range index
+    batch2 = UpdateBatch()
+    batch2.delete("cc", "k2", (2, 0))
+    db.apply_updates(batch2, 2)
+    assert db.get_state("cc", "k2") is None
+    assert [k for k, _, _ in db.get_state_range("cc", "k1", "k4")] == ["k1", "k3"]
+
+
+def test_statedb_snapshot_roundtrip(tmp_path):
+    db = VersionedDB()
+    batch = UpdateBatch()
+    batch.put("ns", "a", b"1", (3, 0))
+    batch.put("ns", "b", b"2", (3, 1))
+    db.apply_updates(batch, 3)
+    path = str(tmp_path / "state.snap")
+    db.snapshot(path)
+    db2 = VersionedDB.load(path)
+    assert db2.savepoint == 3
+    assert db2.get_state("ns", "a") == (b"1", (3, 0))
+    assert [k for k, _, _ in db2.get_state_range("ns", "", "")] == ["a", "b"]
+    # corrupt snapshot -> clean empty DB (rebuild from blocks)
+    raw = bytearray(open(path, "rb").read())
+    raw[20] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    db3 = VersionedDB.load(path)
+    assert db3.savepoint == -1
+
+
+# --- block store -----------------------------------------------------------
+
+def _chain(n, start=0, prev=b""):
+    blocks = []
+    for i in range(start, start + n):
+        env = _endorser_env(f"tx{i}", _rw(writes=[("cc", f"k{i}", b"v")]))
+        blk = _block(i, prev, [env])
+        blocks.append(blk)
+        prev = protoutil.block_header_hash(blk.header)
+    return blocks
+
+
+def test_blockstore_append_get_reopen(tmp_path):
+    d = str(tmp_path / "chains")
+    bs = BlockStore(d)
+    for blk in _chain(5):
+        bs.add_block(blk)
+    assert bs.height == 5
+    assert bs.get_block_by_number(3).header.number == 3
+    assert bs.get_tx_by_id("tx2") is not None
+    assert bs.get_tx_loc("tx4") == (4, 0)
+    assert bs.get_block_by_number(99) is None
+    bs.close()
+    # reopen: index rebuilt by scan
+    bs2 = BlockStore(d)
+    assert bs2.height == 5
+    assert bs2.get_tx_loc("tx1") == (1, 0)
+    # appending continues the chain
+    more = _chain(1, start=5, prev=bs2.last_block_hash)
+    bs2.add_block(more[0])
+    assert bs2.height == 6
+    bs2.close()
+
+
+def test_blockstore_rejects_gaps_and_bad_prev(tmp_path):
+    bs = BlockStore(str(tmp_path / "c"))
+    blocks = _chain(3)
+    bs.add_block(blocks[0])
+    with pytest.raises(BlockStoreError, match="expected block"):
+        bs.add_block(blocks[2])
+    wrong = _block(1, b"\x00" * 32, [])
+    with pytest.raises(BlockStoreError, match="previous_hash"):
+        bs.add_block(wrong)
+    bs.close()
+
+
+def test_blockstore_crops_torn_tail(tmp_path):
+    d = str(tmp_path / "chains")
+    bs = BlockStore(d)
+    for blk in _chain(4):
+        bs.add_block(blk)
+    last_hash_before = None
+    bs.close()
+    # simulate a torn write: chop bytes off the tail
+    path = os.path.join(d, "blockfile_000000")
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[:-10])
+    bs2 = BlockStore(d)
+    assert bs2.height == 3                     # last record cropped
+    assert bs2.get_block_by_number(2) is not None
+    assert bs2.get_block_by_number(3) is None
+    bs2.close()
+
+
+# --- MVCC ------------------------------------------------------------------
+
+def _seed_db():
+    db = VersionedDB()
+    batch = UpdateBatch()
+    batch.put("cc", "a", b"1", (1, 0))
+    batch.put("cc", "b", b"2", (1, 1))
+    db.apply_updates(batch, 1)
+    return db
+
+
+def test_mvcc_read_version_checks():
+    db = _seed_db()
+    V = m.TxValidationCode
+    txs = [
+        # correct version read -> valid
+        ("t0", _rw(reads=[("cc", "a", (1, 0))],
+                   writes=[("cc", "a", b"10")]), V.VALID),
+        # stale version (t0 wrote a in this block) -> conflict
+        ("t1", _rw(reads=[("cc", "a", (1, 0))]), V.VALID),
+        # reads t0's write version -> still conflict (not committed ver)
+        ("t2", _rw(reads=[("cc", "b", (1, 1))],
+                   writes=[("cc", "c", b"3")]), V.VALID),
+        # upstream-invalid stays invalid, writes ignored
+        ("t3", _rw(writes=[("cc", "z", b"9")]), V.ENDORSEMENT_POLICY_FAILURE),
+        # read of a key created earlier in this block -> conflict
+        ("t4", _rw(reads=[("cc", "c", None)]), V.VALID),
+    ]
+    flags, batch = validate_and_prepare_batch(txs, db, 2)
+    assert flags == [V.VALID, V.MVCC_READ_CONFLICT, V.VALID,
+                     V.ENDORSEMENT_POLICY_FAILURE, V.MVCC_READ_CONFLICT]
+    assert batch.get("cc", "a") == (b"10", (2, 0))
+    assert batch.get("cc", "c") == (b"3", (2, 2))
+    assert batch.get("cc", "z") is None
+
+
+def test_mvcc_phantom_detection():
+    db = _seed_db()
+    V = m.TxValidationCode
+    # fingerprint the current range [a, z)
+    results = [(k, ver) for k, _, ver in db.get_state_range("cc", "a", "z")]
+    ok_rw = _rw(ranges=[("cc", "a", "z", results)])
+    txs = [
+        ("t0", _rw(writes=[("cc", "ab", b"new")]), V.VALID),   # insert
+        ("t1", ok_rw, V.VALID),                                # phantom!
+    ]
+    flags, _ = validate_and_prepare_batch(txs, db, 2)
+    assert flags == [V.VALID, V.PHANTOM_READ_CONFLICT]
+    # without the insert the same range validates
+    flags2, _ = validate_and_prepare_batch([("t1", ok_rw, V.VALID)], db, 2)
+    assert flags2 == [V.VALID]
+
+
+# --- kv ledger -------------------------------------------------------------
+
+def test_kvledger_commit_simulate_query(tmp_path):
+    led = KvLedger(str(tmp_path / "ch"), "ch")
+    # genesis-ish block 0 with one write
+    env0 = _endorser_env("boot", _rw(writes=[("cc", "counter", b"0")]))
+    led.commit_block(_block(0, b"", [env0]))
+    assert led.height == 1
+
+    # simulate a tx against committed state
+    sim = led.new_tx_simulator("tx-inc")
+    val = sim.get_state("cc", "counter")
+    assert val == b"0"
+    sim.set_state("cc", "counter", b"1")
+    assert sim.get_state("cc", "counter") == b"1"   # read-your-writes
+    rwset = sim.done()
+
+    env1 = _endorser_env("tx-inc", rwset)
+    flags = led.commit_block(
+        _block(1, led.blockstore.last_block_hash, [env1]))
+    assert flags == [m.TxValidationCode.VALID]
+    assert led.new_query_executor().get_state("cc", "counter") == b"1"
+
+    # a second tx with the now-stale read conflicts
+    env2 = _endorser_env("tx-stale", rwset)
+    flags2 = led.commit_block(
+        _block(2, led.blockstore.last_block_hash, [env2]))
+    assert flags2 == [m.TxValidationCode.MVCC_READ_CONFLICT]
+    assert led.new_query_executor().get_state("cc", "counter") == b"1"
+
+    # processed tx lookup carries validation code
+    pt = led.get_transaction_by_id("tx-stale")
+    assert pt.validation_code == m.TxValidationCode.MVCC_READ_CONFLICT
+    assert led.tx_id_exists("tx-inc")
+    assert led.history.get_history_for_key("cc", "counter") == [(0, 0), (1, 0)]
+    led.close()
+
+
+def test_kvledger_recovery_replays_state(tmp_path):
+    d = str(tmp_path / "ch")
+    led = KvLedger(d, "ch")
+    prev = b""
+    for i in range(5):
+        env = _endorser_env(f"t{i}", _rw(writes=[("cc", f"k{i}", b"v%d" % i)]))
+        led.commit_block(_block(i, prev, [env]))
+        prev = led.blockstore.last_block_hash
+    led.blockstore.close()          # abandon WITHOUT state snapshot
+
+    led2 = KvLedger(d, "ch")        # savepoint behind height -> replay
+    assert led2.height == 5
+    assert led2.new_query_executor().get_state("cc", "k3") == b"v3"
+    assert led2.history.get_history_for_key("cc", "k0") == [(0, 0)]
+    led2.close()
+
+    led3 = KvLedger(d, "ch")        # snapshot current -> no replay
+    assert led3.new_query_executor().get_state("cc", "k4") == b"v4"
+    led3.close()
+
+
+def test_commit_rejects_flags_length_mismatch(tmp_path):
+    led = KvLedger(str(tmp_path / "ch"), "ch")
+    envs = [_endorser_env(f"t{i}", _rw(writes=[("cc", f"k{i}", b"v")]))
+            for i in range(2)]
+    from fabric_mod_tpu.ledger import LedgerError
+    with pytest.raises(LedgerError, match="flags length"):
+        led.commit_block(_block(0, b"", envs),
+                         incoming_flags=[m.TxValidationCode.VALID])
+    led.close()
+
+
+def test_history_same_before_and_after_restart(tmp_path):
+    """Two txs writing the same key in one block: history must record
+    both, identically on commit and on recovery replay."""
+    d = str(tmp_path / "ch")
+    led = KvLedger(d, "ch")
+    envs = [_endorser_env("t0", _rw(writes=[("cc", "k", b"a")])),
+            _endorser_env("t1", _rw(writes=[("cc", "k", b"b")]))]
+    led.commit_block(_block(0, b"", envs))
+    live = led.history.get_history_for_key("cc", "k")
+    led.blockstore.close()
+    led2 = KvLedger(d, "ch")
+    assert led2.history.get_history_for_key("cc", "k") == live == [(0, 0), (0, 1)]
+    assert led2.new_query_executor().get_state("cc", "k") == b"b"
+    led2.close()
+
+
+def test_ledger_manager(tmp_path):
+    mgr = LedgerManager(str(tmp_path / "ledgers"))
+    a = mgr.create_or_open("ch-a")
+    b = mgr.create_or_open("ch-b")
+    assert a is mgr.create_or_open("ch-a")
+    env = _endorser_env("t0", _rw(writes=[("cc", "x", b"1")]))
+    a.commit_block(_block(0, b"", [env]))
+    assert a.height == 1 and b.height == 0
+    assert mgr.ledger_ids() == ["ch-a", "ch-b"]
+    mgr.close()

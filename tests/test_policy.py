@@ -212,6 +212,31 @@ def test_empty_implicit_meta_never_passes(world):
     assert pending.finish([]) is False
 
 
+def test_empty_implicit_meta_all_fails_closed(world):
+    for rule in (m.ImplicitMetaRule.ALL, m.ImplicitMetaRule.MAJORITY):
+        empty = ImplicitMetaPolicyObj([], rule)
+        assert empty.threshold == 1
+        from fabric_mod_tpu.policy import BatchCollector
+        pend = empty.prepare([_sd(world["orgs"]["Org1"]["peer"], b"x")],
+                             BatchCollector())
+        assert pend.finish([]) is False
+
+
+def test_collector_dedups_identical_items(world):
+    """A meta policy handing the same signatures to N sub-policies must
+    not multiply the device batch."""
+    o = world["orgs"]
+    subs = list(_org_writers(world).values())
+    meta = ImplicitMetaPolicyObj(subs, m.ImplicitMetaRule.ANY)
+    from fabric_mod_tpu.policy import BatchCollector
+    col = BatchCollector()
+    sds = [_sd(o["Org1"]["peer"], b"d"), _sd(o["Org2"]["peer"], b"d")]
+    pend = meta.prepare(sds, col)
+    assert len(col.items) == 2               # 3 sub-policies, 2 unique sigs
+    mask = SwCSP().verify_batch(col.items)
+    assert pend.finish(mask) is True
+
+
 def test_channel_policy_reference_not_stale(world):
     """Replacing a named channel policy must take effect on the next
     evaluation (the reference re-resolves per call)."""
