@@ -170,8 +170,17 @@ class BlockStore:
         return protoutil.get_envelopes(block)[loc[1]]
 
     def iter_blocks(self, start: int = 0) -> Iterator[m.Block]:
+        """Sequential scan through the block files (one open + linear
+        read per file, not one open/seek per block)."""
+        cur_fno = None
+        raw = b""
         for num in range(start, self._height):
-            yield self.get_block_by_number(num)
+            fno, off = self._by_num[num]
+            if fno != cur_fno:
+                raw = open(self._file_path(fno), "rb").read()
+                cur_fno = fno
+            (ln,) = struct.unpack_from("<I", raw, off)
+            yield m.Block.decode(raw[off + 4:off + 4 + ln])
 
     def close(self) -> None:
         self._fh.close()

@@ -65,13 +65,25 @@ class TxSimulator(QueryExecutor):
         return got[0] if got else None
 
     def get_state_range(self, ns: str, start: str, end: str):
+        """Range over committed state merged with this simulation's own
+        writes (read-your-writes, consistent with get_state).  The
+        phantom fingerprint records committed results only: at
+        validation time the re-executed range sees earlier txs' writes
+        but never this tx's own."""
         results = []
-        out = []
+        merged = {}
         for key, value, ver in self._db.get_state_range(ns, start, end):
             results.append((key, ver))
-            out.append((key, value))
+            merged[key] = value
         self._rw.add_range_query(ns, start, end, True, results)
-        return iter(out)
+        for (wns, key), value in self._writes.items():
+            if wns != ns or not (start <= key and (not end or key < end)):
+                continue
+            if value is None:
+                merged.pop(key, None)
+            else:
+                merged[key] = value
+        return iter(sorted(merged.items()))
 
     def set_state(self, ns: str, key: str, value: bytes) -> None:
         self._writes[(ns, key)] = value
@@ -192,9 +204,9 @@ class KvLedger:
                     f"{self.blockstore.height}")
             envs = protoutil.get_envelopes(block)
             if incoming_flags is None:
+                # fail closed: absent metadata flags decode to
+                # NOT_VALIDATED, never to VALID
                 incoming_flags = list(protoutil.block_txflags(block))
-                if len(incoming_flags) != len(envs):
-                    incoming_flags = [m.TxValidationCode.VALID] * len(envs)
             elif len(incoming_flags) != len(envs):
                 raise LedgerError(
                     f"flags length {len(incoming_flags)} != "
@@ -208,21 +220,14 @@ class KvLedger:
                     txs.append(("", None, m.TxValidationCode.BAD_PAYLOAD))
                     continue
                 txs.append((txid, tx_rwset_from_envelope(env), flag))
-            flags, batch = validate_and_prepare_batch(txs, self.state, num)
+            flags, batch, tx_writes = validate_and_prepare_batch(
+                txs, self.state, num)
             protoutil.set_block_txflags(block, bytes(flags))
             self.blockstore.add_block(block)
             self.state.apply_updates(batch, num)
-            # History records every valid tx's writes (not the deduped
-            # batch) so commit and recovery replay agree.
-            hist: List[Tuple[int, str, str]] = []
-            for tx_num, ((txid, rwset, _f), flag) in enumerate(
-                    zip(txs, flags)):
-                if flag != m.TxValidationCode.VALID or rwset is None:
-                    continue
-                for ns, kv in parse_tx_rwset(rwset):
-                    for w in kv.writes:
-                        hist.append((tx_num, ns, w.key))
-            self.history.commit(num, hist)
+            # per-tx writes (not the deduped batch) so commit and
+            # recovery replay record identical history
+            self.history.commit(num, tx_writes)
             if (num + 1) % self.SNAPSHOT_EVERY == 0:
                 self.state.snapshot(self._state_path)
             return flags

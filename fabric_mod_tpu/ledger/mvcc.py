@@ -20,13 +20,15 @@ from fabric_mod_tpu.protos import messages as m
 Version = Tuple[int, int]
 
 
-def _combined_get_version(db: VersionedDB, batch: UpdateBatch,
-                          ns: str, key: str) -> Optional[Version]:
-    pending = batch.get(ns, key)
-    if pending is not None:
-        value, version = pending
-        return None if value is None else version
-    return db.get_version(ns, key)
+def _read_conflicts(db: VersionedDB, batch: UpdateBatch,
+                    ns: str, read: m.KVRead) -> bool:
+    """A read conflicts if the key was touched earlier in this block —
+    including deletes — or its committed version moved (reference:
+    validator.go:173 validateKVRead: any key present in the update
+    batch conflicts outright)."""
+    if batch.get(ns, read.key) is not None:
+        return True
+    return db.get_version(ns, read.key) != version_tuple(read.version)
 
 
 def _combined_range(db: VersionedDB, batch: UpdateBatch,
@@ -48,8 +50,7 @@ def _combined_range(db: VersionedDB, batch: UpdateBatch,
 
 def validate_kv_read(db: VersionedDB, batch: UpdateBatch,
                      ns: str, read: m.KVRead) -> bool:
-    committed = _combined_get_version(db, batch, ns, read.key)
-    return committed == version_tuple(read.version)
+    return not _read_conflicts(db, batch, ns, read)
 
 
 def validate_range_query(db: VersionedDB, batch: UpdateBatch, ns: str,
@@ -60,17 +61,21 @@ def validate_range_query(db: VersionedDB, batch: UpdateBatch, ns: str,
 
 def validate_and_prepare_batch(
         txs: List[Tuple[str, Optional[m.TxReadWriteSet], int]],
-        db: VersionedDB, block_num: int) -> Tuple[List[int], UpdateBatch]:
+        db: VersionedDB, block_num: int
+) -> Tuple[List[int], UpdateBatch, List[Tuple[int, str, str]]]:
     """Serial MVCC pass over a block.
 
     `txs` is [(tx_id, rwset | None, incoming_flag)] in block order;
     incoming flags carry upstream verdicts (signature/policy/dup) —
     only VALID transactions are MVCC-checked.  Returns the final
-    per-tx validation codes and the state UpdateBatch of the
-    surviving writes, versioned (block_num, tx_num).
+    per-tx validation codes, the state UpdateBatch of the surviving
+    writes versioned (block_num, tx_num), and the per-tx write list
+    [(tx_num, ns, key)] for the history DB (parsed once here so the
+    commit path never re-decodes rwsets).
     """
     flags: List[int] = []
     batch = UpdateBatch()
+    tx_writes: List[Tuple[int, str, str]] = []
     for tx_num, (txid, rwset, incoming) in enumerate(txs):
         if incoming != m.TxValidationCode.VALID:
             flags.append(incoming)
@@ -106,5 +111,6 @@ def validate_and_prepare_batch(
                     batch.delete(ns, w.key, (block_num, tx_num))
                 else:
                     batch.put(ns, w.key, w.value, (block_num, tx_num))
+                tx_writes.append((tx_num, ns, w.key))
         flags.append(m.TxValidationCode.VALID)
-    return flags, batch
+    return flags, batch, tx_writes

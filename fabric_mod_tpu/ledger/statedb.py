@@ -62,18 +62,23 @@ class VersionedDB:
         return got[1] if got else None
 
     def get_state_range(self, ns: str, start: str,
-                        end: str) -> Iterator[Tuple[str, bytes, Version]]:
-        """Iterate (key, value, version), start <= key < end ('' end =
-        unbounded), in key order."""
+                        end: str) -> List[Tuple[str, bytes, Version]]:
+        """(key, value, version) list, start <= key < end ('' end =
+        unbounded), in key order.  Materialized so readers get a
+        snapshot: a concurrent commit_block (which mutates _keys/_data
+        under the ledger lock) cannot invalidate a half-consumed
+        iterator."""
         keys = self._keys.get(ns, [])
         i = bisect.bisect_left(keys, start)
+        out = []
         while i < len(keys):
             k = keys[i]
             if end and k >= end:
                 break
             v, ver = self._data[(ns, k)]
-            yield k, v, ver
+            out.append((k, v, ver))
             i += 1
+        return out
 
     @property
     def savepoint(self) -> int:

@@ -1,0 +1,4 @@
+"""L5 peer: block validation (one device batch per block), committer,
+endorsement."""
+from fabric_mod_tpu.peer.txvalidator import (  # noqa: F401
+    Committer, TxValidator, ValidationInfoProvider)

@@ -1,0 +1,239 @@
+"""Block validation: the north-star hot path, one device batch per block.
+
+(reference: core/committer/txvalidator/v20/validator.go:182-267
+`TxValidator.Validate` + `ValidateTx` at :300-455,
+core/common/validation/msgvalidation.go:248 `ValidateTransaction`,
+the plugin dispatcher at plugindispatcher/dispatcher.go:102, the
+default VSCC at handlers/validation/builtin/v20/validation_logic.go:185,
+and the endorsement signature-set construction at
+statebased/validator_keylevel.go:245-258.)
+
+Where the reference fans out one goroutine per transaction behind a
+semaphore and verifies each ECDSA signature as it reaches it, this
+validator makes the data flow explicit and device-shaped:
+
+  pass 1 (host)   unpack every tx; syntactic checks; creator identity
+                  validation; stage creator signature + every
+                  endorsement signature of every tx into ONE
+                  BatchCollector (the policy engine's two-phase
+                  prepare handles dedup/principal logic)
+  pass 2 (device) verifier.verify_many(collector.items) — a single
+                  jitted dispatch for the whole block
+  pass 3 (host)   resolve creator verdicts, finish each endorsement-
+                  policy decision against the mask, mark duplicate
+                  tx ids, write the txflags bitmap
+
+MVCC and commit stay in the ledger (kvledger.commit_block).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from fabric_mod_tpu.policy import ApplicationPolicyEvaluator, BatchCollector
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+from fabric_mod_tpu.protos.protoutil import SignedData
+
+V = m.TxValidationCode
+
+
+class ValidationInfoProvider:
+    """Resolves a chaincode namespace to its validation plugin and
+    endorsement policy — the lifecycle's job in the reference
+    (plugindispatcher dispatcher.go:102 + lifecycle ValidationInfo).
+    A static map with a default stands in until the lifecycle SCC
+    lands; the seam is the same.
+    """
+
+    def __init__(self, default_policy: bytes,
+                 per_namespace: Optional[Dict[str, bytes]] = None):
+        self._default = default_policy
+        self._per_ns = dict(per_namespace or {})
+
+    def validation_info(self, ns: str) -> Tuple[str, bytes]:
+        return "vscc", self._per_ns.get(ns, self._default)
+
+    def set_policy(self, ns: str, policy_bytes: bytes) -> None:
+        self._per_ns[ns] = policy_bytes
+
+
+class _TxWork:
+    """Per-tx staging between the host pass and the device verdict."""
+
+    __slots__ = ("flag", "txid", "creator_slot", "pendings", "is_config")
+
+    def __init__(self):
+        self.flag = V.NOT_VALIDATED
+        self.txid = ""
+        self.creator_slot = None          # (batch_idx | None, host_ok)
+        self.pendings = []                # endorsement PendingEvals
+        self.is_config = False
+
+
+class TxValidator:
+    """(reference: txvalidator/v20/validator.go TxValidator)"""
+
+    def __init__(self, channel_id: str, msp_mgr,
+                 policy_eval: ApplicationPolicyEvaluator,
+                 verifier,
+                 vinfo: ValidationInfoProvider,
+                 tx_id_exists: Optional[Callable[[str], bool]] = None):
+        self.channel_id = channel_id
+        self._msp_mgr = msp_mgr
+        self._policy_eval = policy_eval
+        self._verifier = verifier
+        self._vinfo = vinfo
+        self._tx_id_exists = tx_id_exists or (lambda _txid: False)
+
+    # -- pass 1: host unpack + staging -----------------------------------
+    def _stage_tx(self, env: m.Envelope, work: _TxWork,
+                  collector: BatchCollector) -> None:
+        """Syntactic validation + creator/endorsement staging for one
+        tx.  Sets work.flag on terminal failure, else leaves VALID
+        pending the device verdicts.
+        (reference: msgvalidation.go:248 ValidateTransaction)"""
+        if not env.payload:
+            work.flag = V.NIL_ENVELOPE
+            return
+        try:
+            payload = protoutil.unmarshal_envelope_payload(env)
+            ch = m.ChannelHeader.decode(payload.header.channel_header)
+            sh = m.SignatureHeader.decode(payload.header.signature_header)
+        except Exception:
+            work.flag = V.BAD_PAYLOAD
+            return
+        if not ch.channel_id or ch.channel_id != self.channel_id:
+            work.flag = V.BAD_CHANNEL_HEADER
+            return
+        work.txid = ch.tx_id
+
+        # creator signature (reference: msgvalidation.go:26
+        # checkSignatureFromCreator — Validate() then Verify())
+        if not sh.creator or not env.signature:
+            work.flag = V.BAD_CREATOR_SIGNATURE
+            return
+        try:
+            creator = self._msp_mgr.deserialize_identity(sh.creator)
+            self._msp_mgr.validate(creator)
+        except Exception:
+            work.flag = V.BAD_CREATOR_SIGNATURE
+            return
+        item = creator.verify_item(env.payload, env.signature)
+        if item is not None:
+            work.creator_slot = (collector.add(item), False)
+        else:
+            work.creator_slot = (
+                None, creator.verify(env.payload, env.signature))
+
+        if ch.type == m.HeaderType.CONFIG:
+            work.is_config = True
+            return                        # config txs skip endorsement
+        if ch.type != m.HeaderType.ENDORSER_TRANSACTION:
+            work.flag = V.UNKNOWN_TX_TYPE
+            return
+
+        # tx id binding (reference: utils.CheckTxID in msgvalidation)
+        expected = protoutil.compute_tx_id(sh.nonce, sh.creator)
+        if ch.tx_id != expected:
+            work.flag = V.BAD_PROPOSAL_TXID
+            return
+        if self._tx_id_exists(ch.tx_id):
+            work.flag = V.DUPLICATE_TXID
+            return
+
+        # endorsement policy per action (reference: VSCC v20
+        # validation_logic.go:185 + validator_keylevel.go:245-258:
+        # data = proposal-response-payload ‖ endorser-identity)
+        try:
+            tx = protoutil.extract_endorser_tx(payload)
+            if not tx.actions:
+                work.flag = V.NIL_TXACTION
+                return
+            for action in tx.actions:
+                cca, prp_bytes, endorsements = \
+                    protoutil.tx_rwset_and_endorsements(action)
+                if not endorsements:
+                    work.flag = V.ENDORSEMENT_POLICY_FAILURE
+                    return
+                ns = (cca.chaincode_id.name
+                      if cca.chaincode_id is not None else "")
+                _plugin, policy_bytes = self._vinfo.validation_info(ns)
+                sds = [SignedData(data=prp_bytes + e.endorser,
+                                  identity=e.endorser,
+                                  signature=e.signature)
+                       for e in endorsements]
+                work.pendings.append(
+                    self._policy_eval.prepare(policy_bytes, sds, collector))
+        except Exception:
+            work.flag = V.INVALID_ENDORSER_TRANSACTION
+            return
+
+    # -- the three passes -------------------------------------------------
+    def validate(self, block: m.Block) -> List[int]:
+        """Validate every tx of `block`; ONE device dispatch total.
+        Writes the txflags bitmap into the block metadata and returns
+        the flags (reference: validator.go:182-267)."""
+        works: List[_TxWork] = []
+        collector = BatchCollector()
+        for data in block.data.data:
+            work = _TxWork()
+            works.append(work)
+            try:
+                env = m.Envelope.decode(data)
+            except Exception:
+                work.flag = V.BAD_PAYLOAD
+                continue
+            self._stage_tx(env, work, collector)
+
+        # pass 2: the device batch
+        mask = self._verifier.verify_many(collector.items)
+
+        # pass 3: verdicts
+        flags: List[int] = []
+        for work in works:
+            flags.append(self._finish_tx(work, mask))
+        self._mark_in_block_duplicates(works, flags)
+        protoutil.set_block_txflags(block, bytes(flags))
+        return flags
+
+    def _finish_tx(self, work: _TxWork, mask) -> int:
+        if work.flag != V.NOT_VALIDATED:
+            return work.flag
+        bidx, host_ok = work.creator_slot
+        creator_ok = bool(mask[bidx]) if bidx is not None else host_ok
+        if not creator_ok:
+            return V.BAD_CREATOR_SIGNATURE
+        if work.is_config:
+            return V.VALID
+        for pending in work.pendings:
+            if not pending.finish(mask):
+                return V.ENDORSEMENT_POLICY_FAILURE
+        return V.VALID
+
+    @staticmethod
+    def _mark_in_block_duplicates(works: Sequence[_TxWork],
+                                  flags: List[int]) -> None:
+        """First occurrence of a tx id wins
+        (reference: validator.go:281 markTXIdDuplicates)."""
+        seen = set()
+        for i, work in enumerate(works):
+            if flags[i] != V.VALID or not work.txid:
+                continue
+            if work.txid in seen:
+                flags[i] = V.DUPLICATE_TXID
+            else:
+                seen.add(work.txid)
+
+
+class Committer:
+    """Validate + MVCC + commit, the peer's StoreBlock composition
+    (reference: gossip/state/state.go:817 commitBlock ->
+    coordinator StoreBlock -> validator -> kvledger CommitLegacy)."""
+
+    def __init__(self, validator: TxValidator, ledger):
+        self.validator = validator
+        self.ledger = ledger
+
+    def store_block(self, block: m.Block) -> List[int]:
+        flags = self.validator.validate(block)
+        return self.ledger.commit_block(block, flags)

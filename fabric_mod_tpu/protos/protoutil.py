@@ -92,6 +92,44 @@ def envelope_as_signed_data(env: m.Envelope) -> List[SignedData]:
 
 # --- blocks ---------------------------------------------------------------
 
+def create_signed_tx(channel_id: str, chaincode_ns: str,
+                     results: bytes, creator, endorsers: Sequence,
+                     response_payload: bytes = b"",
+                     events: bytes = b"") -> m.Envelope:
+    """Assemble a fully-signed endorser transaction
+    (reference: protoutil/txutils.go CreateSignedTx).
+
+    `creator` and each endorser are SigningIdentity-shaped (serialize()
+    + sign_message()).  Each endorsement signs
+    proposal-response-payload ‖ endorser-identity — exactly the
+    signature-set data the validator reconstructs
+    (statebased/validator_keylevel.go:245-258).
+    """
+    nonce = new_nonce()
+    creator_bytes = creator.serialize()
+    tx_id = compute_tx_id(nonce, creator_bytes)
+    cca = m.ChaincodeAction(
+        results=results, events=events,
+        response=m.Response(status=200, payload=response_payload),
+        chaincode_id=m.ChaincodeID(name=chaincode_ns))
+    prp = m.ProposalResponsePayload(
+        proposal_hash=hashlib.sha256(tx_id.encode()).digest(),
+        extension=cca.encode())
+    prp_bytes = prp.encode()
+    endorsements = [
+        m.Endorsement(endorser=e.serialize(),
+                      signature=e.sign_message(prp_bytes + e.serialize()))
+        for e in endorsers]
+    cap = m.ChaincodeActionPayload(action=m.ChaincodeEndorsedAction(
+        proposal_response_payload=prp_bytes, endorsements=endorsements))
+    tx = m.Transaction(actions=[m.TransactionAction(payload=cap.encode())])
+    ch = make_channel_header(m.HeaderType.ENDORSER_TRANSACTION,
+                             channel_id, tx_id=tx_id)
+    sh = make_signature_header(creator_bytes, nonce)
+    payload = make_payload(ch, sh, tx.encode())
+    return sign_envelope(payload, creator)
+
+
 def block_data_hash(data: m.BlockData) -> bytes:
     h = hashlib.sha256()
     for d in data.data:

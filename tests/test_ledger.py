@@ -182,7 +182,7 @@ def test_mvcc_read_version_checks():
         # read of a key created earlier in this block -> conflict
         ("t4", _rw(reads=[("cc", "c", None)]), V.VALID),
     ]
-    flags, batch = validate_and_prepare_batch(txs, db, 2)
+    flags, batch, tx_writes = validate_and_prepare_batch(txs, db, 2)
     assert flags == [V.VALID, V.MVCC_READ_CONFLICT, V.VALID,
                      V.ENDORSEMENT_POLICY_FAILURE, V.MVCC_READ_CONFLICT]
     assert batch.get("cc", "a") == (b"10", (2, 0))
@@ -200,10 +200,10 @@ def test_mvcc_phantom_detection():
         ("t0", _rw(writes=[("cc", "ab", b"new")]), V.VALID),   # insert
         ("t1", ok_rw, V.VALID),                                # phantom!
     ]
-    flags, _ = validate_and_prepare_batch(txs, db, 2)
+    flags, _, _ = validate_and_prepare_batch(txs, db, 2)
     assert flags == [V.VALID, V.PHANTOM_READ_CONFLICT]
     # without the insert the same range validates
-    flags2, _ = validate_and_prepare_batch([("t1", ok_rw, V.VALID)], db, 2)
+    flags2, _, _ = validate_and_prepare_batch([("t1", ok_rw, V.VALID)], db, 2)
     assert flags2 == [V.VALID]
 
 
@@ -264,6 +264,33 @@ def test_kvledger_recovery_replays_state(tmp_path):
     led3 = KvLedger(d, "ch")        # snapshot current -> no replay
     assert led3.new_query_executor().get_state("cc", "k4") == b"v4"
     led3.close()
+
+
+def test_mvcc_read_of_inblock_delete_conflicts():
+    """A key deleted earlier in the block conflicts with any read of
+    it — even a read recorded as 'absent' (reference validateKVRead:
+    any key in the pending batch conflicts)."""
+    db = _seed_db()
+    V = m.TxValidationCode
+    txs = [
+        ("t0", _rw(writes=[("cc", "a", None)]), V.VALID),   # delete a
+        ("t1", _rw(reads=[("cc", "a", None)]), V.VALID),    # read "absent"
+    ]
+    flags, _, _ = validate_and_prepare_batch(txs, db, 2)
+    assert flags == [V.VALID, V.MVCC_READ_CONFLICT]
+
+
+def test_simulator_range_read_your_writes(tmp_path):
+    led = KvLedger(str(tmp_path / "ch"), "ch")
+    env0 = _endorser_env("boot", _rw(writes=[("cc", "a", b"1"),
+                                             ("cc", "c", b"3")]))
+    led.commit_block(_block(0, b"", [env0]))
+    sim = led.new_tx_simulator("t")
+    sim.set_state("cc", "b", b"2")
+    sim.delete_state("cc", "c")
+    got = dict(sim.get_state_range("cc", "a", "z"))
+    assert got == {"a": b"1", "b": b"2"}     # own write in, own delete out
+    led.close()
 
 
 def test_commit_rejects_flags_length_mismatch(tmp_path):
