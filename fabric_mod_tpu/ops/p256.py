@@ -39,12 +39,36 @@ from fabric_mod_tpu.ops.limbs import (
     mul_small, canonical, bits_le, inv_mont, be_bytes_to_limbs,
 )
 
+WINDOW = 4                     # Shamir ladder window width (bits)
+N_WINDOWS = 256 // WINDOW
+TABLE = 1 << WINDOW
+
 # --- Curve constants (NIST P-256 / secp256r1) ------------------------------
 P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
 N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
 B = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
 GX = 0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296
 GY = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5
+
+
+def _affine_add(p1, p2):
+    """Host-side python-int affine addition (build-time table precompute
+    only — never on the hot path)."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2 and (y1 + y2) % P == 0:
+        return None
+    if p1 == p2:
+        lam = (3 * x1 * x1 - 3) * pow(2 * y1, -1, P) % P
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, -1, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
 
 
 @functools.lru_cache(maxsize=None)
@@ -61,6 +85,25 @@ def _consts():
     gx_m = limbs.int_to_limbs((GX * R) % P)
     gy_m = limbs.int_to_limbs((GY * R) % P)
     return fp, fn, b_m, gx_m, gy_m
+
+
+@functools.lru_cache(maxsize=None)
+def _g_table():
+    """(3, TABLE, K) numpy constants: projective Montgomery-domain
+    multiples [inf, G, 2G, ..., 15G] of the fixed base point, shared by
+    every batch lane of the windowed ladder (the base point is a curve
+    constant, so this table is host-precomputed once — unlike the
+    per-signature Q table built on device)."""
+    R = 1 << limbs.RBITS
+    one_m = limbs.int_to_limbs(R % P)
+    xs, ys, zs = [np.zeros(K, np.int32)], [one_m.copy()], [np.zeros(K, np.int32)]
+    acc = None
+    for _ in range(1, TABLE):
+        acc = _affine_add(acc, (GX, GY))
+        xs.append(limbs.int_to_limbs(acc[0] * R % P))
+        ys.append(limbs.int_to_limbs(acc[1] * R % P))
+        zs.append(one_m.copy())
+    return np.stack([np.stack(xs), np.stack(ys), np.stack(zs)])
 
 
 # --- Complete projective point addition (RCB alg. 4, a = -3) ---------------
@@ -122,7 +165,46 @@ def point_add(p1, p2, fp: FieldSpec, b_m: jnp.ndarray):
 
 
 def point_double(p, fp: FieldSpec, b_m: jnp.ndarray):
-    return point_add(p, p, fp, b_m)
+    """Complete projective doubling (RCB alg. 6, a = -3), Montgomery
+    domain.  Valid for ALL curve points including infinity.  3 squarings
+    (cheap via sb_sqr_full) + 8 muls + 2 muls-by-b — ~20% cheaper than
+    doubling through the generic complete addition."""
+    X, Y, Z = p
+    t0 = mont_sqr(X, fp)
+    t1 = mont_sqr(Y, fp)
+    t2 = mont_sqr(Z, fp)
+    t3 = mont_mul(X, Y, fp)
+    t3 = add(t3, t3)
+    Z3 = mont_mul(X, Z, fp)
+    Z3 = add(Z3, Z3)
+    Y3 = mont_mul(b_m, t2, fp)
+    Y3 = sub(Y3, Z3)
+    X3 = add(Y3, Y3)
+    Y3 = add(X3, Y3)
+    X3 = sub(t1, Y3)
+    Y3 = add(t1, Y3)
+    Y3 = mont_mul(X3, Y3, fp)
+    X3 = mont_mul(X3, t3, fp)
+    t3 = add(t2, t2)
+    t2 = add(t2, t3)
+    Z3 = mont_mul(b_m, Z3, fp)
+    Z3 = sub(Z3, t2)
+    Z3 = sub(Z3, t0)
+    t3 = add(Z3, Z3)
+    Z3 = add(Z3, t3)
+    t3 = add(t0, t0)
+    t0 = add(t3, t0)
+    t0 = sub(t0, t2)
+    t0 = mont_mul(t0, Z3, fp)
+    Y3 = add(Y3, t0)
+    t0 = mont_mul(Y, Z, fp)
+    t0 = add(t0, t0)
+    Z3 = mont_mul(t0, Z3, fp)
+    X3 = sub(X3, Z3)
+    Z3 = mont_mul(t0, t1, fp)
+    Z3 = add(Z3, Z3)
+    Z3 = add(Z3, Z3)
+    return (X3, Y3, Z3)
 
 
 def infinity(shape_prefix) -> tuple:
@@ -176,31 +258,52 @@ def verify_core(e: jnp.ndarray, r: jnp.ndarray, s: jnp.ndarray,
     w_mn = inv_mont(s_mn, fn)
     u1 = canonical(mont_mul(e, w_mn, fn), fn)
     u2 = canonical(mont_mul(r, w_mn, fn), fn)
-    u1_bits = bits_le(u1)          # (batch, 256) LSB first
-    u2_bits = bits_le(u2)
 
-    # Table [inf, G, Q, G+Q] (projective, Montgomery domain).
-    inf = infinity(batch)
-    g = (jnp.broadcast_to(gx_m, batch + (K,)).astype(jnp.int32),
-         jnp.broadcast_to(gy_m, batch + (K,)).astype(jnp.int32),
-         jnp.broadcast_to(fp.one_mont, batch + (K,)).astype(jnp.int32))
-    q = (qx_m, qy_m, g[2])
-    gq = point_add(g, q, fp, b_m)
-    table = tuple(
-        jnp.stack([inf[c], g[c], q[c], gq[c]], axis=-2)      # (batch, 4, K)
+    # WINDOW-bit window values, MSB-window first: (batch, N_WINDOWS).
+    wexp = jnp.asarray(1 << np.arange(WINDOW), jnp.int32)
+    def windows_msb_first(u):
+        bits = bits_le(u)                                    # (batch, 256)
+        w = bits.reshape(batch + (N_WINDOWS, WINDOW)) @ wexp # (batch, NW)
+        return w[..., ::-1]
+    u1_w = windows_msb_first(u1)
+    u2_w = windows_msb_first(u2)
+
+    # Per-lane table [inf, Q, 2Q, ..., 15Q] (projective, Montgomery
+    # domain), built on device with 7 doublings + 7 additions; the
+    # fixed-base counterpart [inf, G, ..., 15G] is a host-precomputed
+    # shared constant (_g_table) — the windowed split of the reference's
+    # per-signature scalar mult (bccsp/sw/ecdsa.go:41-57 delegates to Go
+    # stdlib; here the ladder IS the hot loop, so the window buys ~1.6x).
+    one_m = jnp.broadcast_to(fp.one_mont, batch + (K,)).astype(jnp.int32)
+    q1 = (qx_m, qy_m, one_m)
+    qtab = [infinity(batch), q1]
+    for i in range(2, TABLE):
+        if i % 2 == 0:
+            qtab.append(point_double(qtab[i // 2], fp, b_m))
+        else:
+            qtab.append(point_add(qtab[i - 1], q1, fp, b_m))
+    q_table = tuple(
+        jnp.stack([pt[c] for pt in qtab], axis=-2)           # (batch, 16, K)
         for c in range(3))
+    g_table = tuple(jnp.asarray(_g_table()[c]) for c in range(3))  # (16, K)
 
-    # Shamir ladder, MSB -> LSB.
-    idx_bits = jnp.stack([u1_bits, u2_bits], axis=-1)        # (batch, 256, 2)
-    sel_seq = jnp.moveaxis(idx_bits[..., ::-1, :], -2, 0)    # (256, batch, 2)
+    # Windowed Shamir ladder, MSB -> LSB: per step WINDOW doublings,
+    # one add from each table (complete addition absorbs the zero-window
+    # infinity entries branch-free).
+    sel_seq = jnp.moveaxis(
+        jnp.stack([u1_w, u2_w], axis=-1), -2, 0)             # (NW, batch, 2)
 
-    def step(acc, bits2):
-        acc = point_double(acc, fp, b_m)
-        idx = bits2[..., 0] + 2 * bits2[..., 1]              # (batch,)
-        onehot = jax.nn.one_hot(idx, 4, dtype=jnp.int32)     # (batch, 4)
-        t = tuple(jnp.einsum("...i,...ik->...k", onehot, table[c])
-                  for c in range(3))
-        acc = point_add(acc, t, fp, b_m)
+    def step(acc, w2):
+        for _ in range(WINDOW):
+            acc = point_double(acc, fp, b_m)
+        oh_q = jax.nn.one_hot(w2[..., 1], TABLE, dtype=jnp.int32)
+        acc = point_add(acc, tuple(
+            jnp.einsum("...i,...ik->...k", oh_q, q_table[c])
+            for c in range(3)), fp, b_m)
+        oh_g = jax.nn.one_hot(w2[..., 0], TABLE, dtype=jnp.int32)
+        acc = point_add(acc, tuple(
+            jnp.einsum("...i,ik->...k", oh_g, g_table[c])
+            for c in range(3)), fp, b_m)
         return acc, None
 
     acc, _ = jax.lax.scan(step, infinity(batch), sel_seq)

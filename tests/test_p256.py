@@ -97,6 +97,33 @@ def test_point_add_matches_reference(rng):
         assert got == ref_add(u, v), f"case {i}"
 
 
+def test_point_double_matches_reference(rng):
+    import jax.numpy as jnp
+    fp, _, b_m, _, _ = p256._consts()
+    pts = [ref_mul(rng.randrange(1, N), G) for _ in range(5)] + [None]
+    a = tuple(jnp.stack([np.asarray(to_proj_mont(pt)[i]) for pt in pts])
+              for i in range(3))
+    out = p256.point_double(a, fp, b_m)
+    for i, pt in enumerate(pts):
+        got = from_proj_mont(tuple(np.asarray(out[c][i]) for c in range(3)))
+        assert got == ref_add(pt, pt) if pt else got is None, f"case {i}"
+
+
+def test_g_table_is_correct():
+    R = 1 << limbs.RBITS
+    tab = p256._g_table()
+    acc = None
+    for k in range(p256.TABLE):
+        if k == 0:
+            assert limbs.limbs_to_int(tab[0][0]) == 0
+            assert limbs.limbs_to_int(tab[2][0]) == 0
+        else:
+            acc = ref_add(acc, G)
+            assert limbs.limbs_to_int(tab[0][k]) == acc[0] * R % P
+            assert limbs.limbs_to_int(tab[1][k]) == acc[1] * R % P
+            assert limbs.limbs_to_int(tab[2][k]) == R % P
+
+
 # --- real signatures (cryptography / OpenSSL ground truth) ----------------
 
 def make_sigs(n_keys, n_sigs, rng):
