@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Benchmark driver: batched ECDSA-P256 verification throughput on device.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Headline metric per BASELINE.md: ECDSA-P256 verifies/sec/chip on the
 device batch verifier vs the software CSP (`bccsp.sw`, backed by
@@ -12,13 +12,22 @@ limb marshalling + one jitted device program per bucket — the same
 path the block validator uses, so the number is honest about host
 overheads, not a kernel-only figure.
 
+Robustness (BENCH_r02 post-mortem): the TPU backend behind the axon
+tunnel can FAIL (UNAVAILABLE) or HANG INDEFINITELY at jax.devices().
+All jax work therefore runs in a supervised child process with a hard
+timeout and bounded retries; if the TPU never comes up the supervisor
+re-runs the same measurement on the CPU backend and reports it with
+"platform": "cpu" plus a diagnosis — a real number with an honest
+label instead of rc=1.
+
 Baseline is measured in-process each run (same machine, same OpenSSL)
 rather than hard-coded.  Diagnostics go to stderr; stdout carries
 exactly the one JSON line the driver parses.
 """
 import argparse
-import hashlib
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -26,6 +35,10 @@ import time
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
+
+# ---------------------------------------------------------------------------
+# Measurement (runs inside the worker child)
+# ---------------------------------------------------------------------------
 
 def make_items(n: int, n_keys: int = 64):
     """n real signatures (~0.4% deliberately invalid) as VerifyItems,
@@ -41,11 +54,11 @@ def measure_sw(items, expect) -> float:
     from fabric_mod_tpu.bccsp.sw import SwCSP
 
     csp = SwCSP()
-    sub = items[:256]
+    sub = items[:1024]
     t0 = time.perf_counter()
     got = csp.verify_batch(sub)
     dt = time.perf_counter() - t0
-    if got != expect[:256]:
+    if got != expect[:len(sub)]:
         raise AssertionError("sw baseline verdicts wrong")
     return len(sub) / dt
 
@@ -55,8 +68,10 @@ def measure_device(items, expect, reps: int) -> float:
 
     from fabric_mod_tpu.bccsp.tpu import TpuVerifier
 
-    log(f"jax platform: {jax.devices()[0].platform}, "
-        f"{len(jax.devices())} device(s)")
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    log(f"jax platform: {devs[0].platform}, {len(devs)} device(s), "
+        f"backend init {time.perf_counter() - t0:.1f}s")
     v = TpuVerifier()
     t0 = time.perf_counter()
     got = v.verify_many(items)          # includes compile on cold cache
@@ -137,52 +152,179 @@ def measure_block(n_txs: int, reps: int) -> tuple:
     sw_rate = run(sw_validator, 1)
     log(f"sw block validation: {sw_rate:,.0f} tx/s")
     dev_validator = make_validator(TpuVerifier())
+    t0 = time.perf_counter()
     run(dev_validator, 1)                   # warm-up/compile
+    log(f"block warm-up (incl. compile): {time.perf_counter() - t0:.1f}s")
     dev_rate = run(dev_validator, reps)
     log(f"device block validation: {dev_rate:,.0f} tx/s")
     return dev_rate, sw_rate
+
+
+def measure_e2e(n_txs: int) -> tuple:
+    """End-to-end validated tx/s: endorsed txs -> solo orderer cuts
+    blocks -> peer verifies (device batch) + MVCC + commits
+    (BASELINE config #3 shape, in-process network)."""
+    from fabric_mod_tpu.bccsp.sw import SwCSP
+    from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier, TpuVerifier
+    from fabric_mod_tpu.e2e import run_pipeline
+
+    sw_rate = run_pipeline(min(n_txs, 2000), FakeBatchVerifier(SwCSP()))
+    log(f"sw e2e: {sw_rate:,.0f} tx/s")
+    verifier = TpuVerifier()
+    run_pipeline(min(n_txs, 2000), verifier)      # warm-up/compile
+    dev_rate = run_pipeline(n_txs, verifier)
+    log(f"device e2e: {dev_rate:,.0f} tx/s")
+    return dev_rate, sw_rate
+
+
+def run_worker(args) -> int:
+    """The actual measurement; prints the final JSON line on stdout."""
+    # Under the axon sitecustomize the JAX_PLATFORMS env var alone does
+    # NOT disable the TPU plugin (a half-disabled axon hangs); the
+    # config update is the reliable switch, and it must happen before
+    # any jax use in this process.
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    if args.metric == "block":
+        dev_rate, sw_rate = measure_block(min(args.batch, 1000), args.reps)
+        out = {
+            "metric": "validated_tx_per_sec_1k_block_2of3",
+            "value": round(dev_rate, 1),
+            "unit": "tx/s",
+            "vs_baseline": round(dev_rate / sw_rate, 3),
+        }
+    elif args.metric == "e2e":
+        # the batch IS the tx count (the supervisor's CPU-fallback
+        # bound must be respected; the consenter's batch timeout cuts
+        # partial blocks, so small counts still flow)
+        dev_rate, sw_rate = measure_e2e(args.batch)
+        out = {
+            "metric": "e2e_validated_tx_per_sec",
+            "value": round(dev_rate, 1),
+            "unit": "tx/s",
+            "vs_baseline": round(dev_rate / sw_rate, 3),
+        }
+    else:
+        items, expect = make_items(args.batch)
+        sw_rate = measure_sw(items, expect)
+        log(f"sw baseline: {sw_rate:,.0f} verifies/s")
+        dev_rate = measure_device(items, expect, args.reps)
+        log(f"device: {dev_rate:,.0f} verifies/s "
+            f"({dev_rate / sw_rate:.2f}x sw)")
+        out = {
+            "metric": "ecdsa_p256_verifies_per_sec",
+            "value": round(dev_rate, 1),
+            "unit": "verifies/s",
+            "vs_baseline": round(dev_rate / sw_rate, 3),
+        }
+    import jax
+    out["platform"] = jax.devices()[0].platform
+    print(json.dumps(out))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Supervisor (parent): hard timeouts, retries, CPU fallback
+# ---------------------------------------------------------------------------
+
+def _spawn_worker(argv, env, timeout_s: float):
+    """Run this script with --_worker; return (json_dict | None, note)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--_worker"] + argv
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(cmd, env=env, timeout=timeout_s,
+                              stdout=subprocess.PIPE, stderr=sys.stderr)
+    except subprocess.TimeoutExpired:
+        return None, f"worker timed out after {timeout_s:.0f}s"
+    dt = time.perf_counter() - t0
+    if proc.returncode != 0:
+        return None, f"worker rc={proc.returncode} after {dt:.0f}s"
+    for line in reversed(proc.stdout.decode().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), f"ok in {dt:.0f}s"
+            except json.JSONDecodeError:
+                pass
+    return None, "worker produced no JSON"
+
+
+def supervise(args, argv) -> int:
+    # Calibrated against the observed axon failure mode (r2 + probes
+    # this round): backend init can hang ~25min before erroring, so
+    # per-attempt timeouts must be hard and the CPU fallback must be
+    # cheap enough to fit whatever budget remains.
+    timeout_s = float(os.environ.get("FABRIC_MOD_TPU_BENCH_TIMEOUT", "600"))
+    attempts = int(os.environ.get("FABRIC_MOD_TPU_BENCH_ATTEMPTS", "2"))
+    base_env = dict(os.environ)
+
+    note = "no TPU attempts configured"
+    if not args.cpu:
+        for attempt in range(1, attempts + 1):
+            log(f"[bench] device attempt {attempt}/{attempts} "
+                f"(timeout {timeout_s:.0f}s)")
+            result, note = _spawn_worker(argv, base_env, timeout_s)
+            log(f"[bench] device attempt {attempt}: {note}")
+            if result is not None:
+                print(json.dumps(result))
+                return 0
+            if attempt < attempts:
+                backoff = 15 * attempt
+                log(f"[bench] backing off {backoff}s before retry")
+                time.sleep(backoff)
+        diagnosis = ("TPU backend init failed or hung in all "
+                     f"{attempts} attempts; falling back to CPU backend. "
+                     "Last failure: " + note)
+        log(f"[bench] {diagnosis}")
+    else:
+        diagnosis = "forced --cpu"
+
+    cpu_env = dict(base_env)
+    cpu_env["JAX_PLATFORMS"] = "cpu"
+    if args.cpu:
+        # explicit --cpu: honor the user's batch/reps exactly
+        cpu_argv = argv
+    else:
+        # emergency fallback after TPU attempts burned the budget:
+        # bound the work (smaller batch, single rep) — the
+        # vs_baseline ratio stays honest, the wall-clock stays small
+        cpu_argv = ["--batch", str(min(args.batch, 512)), "--reps", "1",
+                    "--metric", args.metric]
+    result, note = _spawn_worker(cpu_argv, cpu_env, timeout_s)
+    log(f"[bench] cpu fallback: {note}")
+    if result is not None:
+        result["platform"] = "cpu"
+        if not args.cpu:
+            result["note"] = diagnosis
+        print(json.dumps(result))
+        return 0
+    # Even the CPU run failed — emit a parseable failure record.
+    print(json.dumps({
+        "metric": args.metric, "value": 0.0, "unit": "FAILED",
+        "vs_baseline": 0.0, "error": f"{diagnosis}; cpu fallback: {note}",
+    }))
+    return 1
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=2048)
     ap.add_argument("--reps", type=int, default=3)
-    ap.add_argument("--metric", choices=("verify", "block"),
+    ap.add_argument("--metric", choices=("verify", "block", "e2e"),
                     default="verify")
     ap.add_argument("--cpu", action="store_true",
-                    help="force the CPU backend (local testing)")
-    args = ap.parse_args()
+                    help="force the CPU backend")
+    ap.add_argument("--_worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    args, _ = ap.parse_known_args()
 
-    if args.cpu:
-        import os
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+    if args._worker:
+        return run_worker(args)
 
-    if args.metric == "block":
-        dev_rate, sw_rate = measure_block(min(args.batch, 1000), args.reps)
-        print(json.dumps({
-            "metric": "validated_tx_per_sec_1k_block_2of3",
-            "value": round(dev_rate, 1),
-            "unit": "tx/s",
-            "vs_baseline": round(dev_rate / sw_rate, 3),
-        }))
-        return 0
-
-    items, expect = make_items(args.batch)
-    sw_rate = measure_sw(items, expect)
-    log(f"sw baseline: {sw_rate:,.0f} verifies/s")
-    dev_rate = measure_device(items, expect, args.reps)
-    log(f"device: {dev_rate:,.0f} verifies/s "
-        f"({dev_rate / sw_rate:.2f}x sw)")
-
-    print(json.dumps({
-        "metric": "ecdsa_p256_verifies_per_sec",
-        "value": round(dev_rate, 1),
-        "unit": "verifies/s",
-        "vs_baseline": round(dev_rate / sw_rate, 3),
-    }))
-    return 0
+    argv = ["--batch", str(args.batch), "--reps", str(args.reps),
+            "--metric", args.metric]
+    return supervise(args, argv)
 
 
 if __name__ == "__main__":
