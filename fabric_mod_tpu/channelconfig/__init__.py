@@ -1,0 +1,12 @@
+"""Channel configuration: typed bundle, config-tx validation, genesis
+construction (reference: common/channelconfig, common/configtx,
+internal/configtxgen)."""
+from fabric_mod_tpu.channelconfig.bundle import (        # noqa: F401
+    APPLICATION, ORDERER, Bundle, ConfigError, groups_of, policies_of,
+    values_of)
+from fabric_mod_tpu.channelconfig.configtx import (      # noqa: F401
+    ConfigTxError, config_from_block, extract_config_update,
+    propose_config_update)
+from fabric_mod_tpu.channelconfig import genesis         # noqa: F401
+from fabric_mod_tpu.channelconfig.update import (        # noqa: F401
+    compute_update, signed_update_envelope)

@@ -1,0 +1,243 @@
+"""Typed, immutable view of a channel's on-ledger configuration.
+
+(reference: common/channelconfig/bundle.go `Bundle` — the materialized
+config-tx view every service consults — plus api.go:262's typed
+Application/Orderer/Channel accessors.)
+
+A Bundle is built once from a `Config` proto tree and never mutated;
+config updates produce a NEW bundle that is atomically swapped in by
+whoever owns the reference (registrar, validator) — the reference's
+bundlesource.go:103 callback pattern.  That immutability is what makes
+the commit path safe to pipeline: a block validates against exactly one
+bundle snapshot.
+
+The policy tree and MSP manager are materialized here so every consumer
+shares one compiled form: signature policies compile to the two-phase
+batch-first evaluators of policy/cauthdsl.py (the device-batch seam),
+implicit meta policies resolve over the group tree exactly like
+common/policies/implicitmeta.go.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from cryptography import x509
+
+from fabric_mod_tpu.msp.mspimpl import Msp, MspManager, NodeOUs
+from fabric_mod_tpu.policy.cauthdsl import CompiledPolicy, PolicyError
+from fabric_mod_tpu.policy.manager import PolicyManager
+from fabric_mod_tpu.protos import messages as m
+
+# Canonical group / value keys (reference: common/channelconfig/api.go)
+APPLICATION = "Application"
+ORDERER = "Orderer"
+MSP_KEY = "MSP"
+BATCH_SIZE = "BatchSize"
+BATCH_TIMEOUT = "BatchTimeout"
+CONSENSUS_TYPE = "ConsensusType"
+CAPABILITIES = "Capabilities"
+HASHING_ALGORITHM = "HashingAlgorithm"
+BLOCK_DATA_HASHING_STRUCTURE = "BlockDataHashingStructure"
+ORDERER_ADDRESSES = "OrdererAddresses"
+
+BLOCK_VALIDATION_POLICY = "BlockValidation"
+
+
+class ConfigError(Exception):
+    pass
+
+
+# -- map-style accessors over the repeated entry encoding -------------------
+
+def groups_of(g: m.ConfigGroup) -> Dict[str, m.ConfigGroup]:
+    return {e.key: e.value for e in g.groups if e.value is not None}
+
+
+def values_of(g: m.ConfigGroup) -> Dict[str, m.ConfigValue]:
+    return {e.key: e.value for e in g.values if e.value is not None}
+
+
+def policies_of(g: m.ConfigGroup) -> Dict[str, m.ConfigPolicy]:
+    return {e.key: e.value for e in g.policies if e.value is not None}
+
+
+def set_group(g: m.ConfigGroup, key: str, sub: m.ConfigGroup) -> None:
+    g.groups = [e for e in g.groups if e.key != key]
+    g.groups.append(m.ConfigGroupEntry(key=key, value=sub))
+    g.groups.sort(key=lambda e: e.key)
+
+
+def set_value(g: m.ConfigGroup, key: str, val: m.ConfigValue) -> None:
+    g.values = [e for e in g.values if e.key != key]
+    g.values.append(m.ConfigValueEntry(key=key, value=val))
+    g.values.sort(key=lambda e: e.key)
+
+
+def set_policy(g: m.ConfigGroup, key: str, pol: m.ConfigPolicy) -> None:
+    g.policies = [e for e in g.policies if e.key != key]
+    g.policies.append(m.ConfigPolicyEntry(key=key, value=pol))
+    g.policies.sort(key=lambda e: e.key)
+
+
+# -- MSP materialization ----------------------------------------------------
+
+def msp_from_config(conf: m.MSPConfig, csp) -> Msp:
+    """FabricMSPConfig -> live Msp (reference: msp/configbuilder.go +
+    mspimplsetup.go — certs, CRLs, NodeOUs)."""
+    if conf.type != 0:
+        raise ConfigError(f"unsupported MSP type {conf.type}")
+    f = m.FabricMSPConfig.decode(conf.config)
+    if not f.name or not f.root_certs:
+        raise ConfigError("MSP config needs a name and root certs")
+    roots = [x509.load_pem_x509_certificate(c) for c in f.root_certs]
+    inters = [x509.load_pem_x509_certificate(c)
+              for c in f.intermediate_certs]
+    admins = [x509.load_pem_x509_certificate(c) for c in f.admins]
+    crls = [x509.load_der_x509_crl(c) for c in f.revocation_list]
+    node_ous = None
+    if f.fabric_node_ous is not None and f.fabric_node_ous.enable:
+        nu = f.fabric_node_ous
+
+        def ou(ident, default):
+            return (ident.organizational_unit_identifier
+                    if ident is not None and
+                    ident.organizational_unit_identifier else default)
+        node_ous = NodeOUs(
+            enable=True,
+            client_ou=ou(nu.client_ou_identifier, "client"),
+            peer_ou=ou(nu.peer_ou_identifier, "peer"),
+            admin_ou=ou(nu.admin_ou_identifier, "admin"),
+            orderer_ou=ou(nu.orderer_ou_identifier, "orderer"))
+    return Msp(f.name, csp, roots, inters, admins, crls=crls,
+               node_ous=node_ous)
+
+
+# -- typed sections ---------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class OrdererConfig:
+    """(reference: channelconfig/orderer.go OrdererConfig)"""
+    batch_size: m.BatchSize
+    batch_timeout_s: float
+    consensus_type: str
+    org_mspids: Tuple[str, ...]
+    capabilities: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ApplicationConfig:
+    """(reference: channelconfig/application.go ApplicationConfig)"""
+    org_mspids: Tuple[str, ...]
+    capabilities: Tuple[str, ...]
+
+
+def _parse_timeout(s: str) -> float:
+    """Duration strings the way the reference's yaml uses them: "2s",
+    "500ms", "1m"."""
+    s = s.strip()
+    for suffix, mult in (("ms", 1e-3), ("s", 1.0), ("m", 60.0)):
+        if s.endswith(suffix):
+            return float(s[:-len(suffix)]) * mult
+    return float(s)
+
+
+def _capabilities(values: Dict[str, m.ConfigValue]) -> Tuple[str, ...]:
+    cv = values.get(CAPABILITIES)
+    if cv is None:
+        return ()
+    caps = m.Capabilities.decode(cv.value)
+    return tuple(e.key for e in caps.capabilities)
+
+
+# -- the bundle -------------------------------------------------------------
+
+class Bundle:
+    """Immutable channel config snapshot: raw tree + typed views +
+    policy/MSP managers (reference: channelconfig/bundle.go)."""
+
+    def __init__(self, channel_id: str, config: m.Config, csp):
+        if config.channel_group is None:
+            raise ConfigError("config has no channel group")
+        self.channel_id = channel_id
+        self.config = config
+        self.sequence = config.sequence
+        root = config.channel_group
+        top = groups_of(root)
+
+        # MSPs first (policies compile against them)
+        msps: List[Msp] = []
+        for section in (APPLICATION, ORDERER):
+            sec = top.get(section)
+            if sec is None:
+                continue
+            for org_name, org in groups_of(sec).items():
+                mv = values_of(org).get(MSP_KEY)
+                if mv is None:
+                    raise ConfigError(f"org {org_name} has no MSP value")
+                msps.append(msp_from_config(m.MSPConfig.decode(mv.value), csp))
+        self.msp_manager = MspManager(msps)
+
+        # Policy tree mirrors the group tree (reference: the policy
+        # manager is constructed per config in policies.NewManagerImpl)
+        self.policy_manager = self._build_policy_tree("Channel", root)
+
+        # Typed sections
+        self.orderer: Optional[OrdererConfig] = None
+        osec = top.get(ORDERER)
+        if osec is not None:
+            vals = values_of(osec)
+            if BATCH_SIZE not in vals or BATCH_TIMEOUT not in vals:
+                raise ConfigError("orderer group needs BatchSize/BatchTimeout")
+            ct = (m.ConsensusType.decode(vals[CONSENSUS_TYPE].value).type
+                  if CONSENSUS_TYPE in vals else "solo")
+            self.orderer = OrdererConfig(
+                batch_size=m.BatchSize.decode(vals[BATCH_SIZE].value),
+                batch_timeout_s=_parse_timeout(
+                    m.BatchTimeout.decode(vals[BATCH_TIMEOUT].value).timeout),
+                consensus_type=ct,
+                org_mspids=tuple(sorted(groups_of(osec))),
+                capabilities=_capabilities(vals))
+
+        self.application: Optional[ApplicationConfig] = None
+        asec = top.get(APPLICATION)
+        if asec is not None:
+            self.application = ApplicationConfig(
+                org_mspids=tuple(sorted(groups_of(asec))),
+                capabilities=_capabilities(values_of(asec)))
+
+    def _build_policy_tree(self, name: str,
+                           group: m.ConfigGroup) -> PolicyManager:
+        mgr = PolicyManager(name)
+        for key, sub in sorted(groups_of(group).items()):
+            mgr.add_sub_manager(self._build_policy_tree(key, sub))
+        metas: List[Tuple[str, m.ImplicitMetaPolicy]] = []
+        for pname, cp in sorted(policies_of(group).items()):
+            pol = cp.policy
+            if pol is None:
+                continue
+            if pol.type == m.PolicyType.SIGNATURE:
+                env = m.SignaturePolicyEnvelope.decode(pol.value)
+                mgr.add_policy(pname, CompiledPolicy(env, self.msp_manager))
+            elif pol.type == m.PolicyType.IMPLICIT_META:
+                metas.append((pname, m.ImplicitMetaPolicy.decode(pol.value)))
+            else:
+                raise PolicyError(f"unsupported policy type {pol.type}")
+        for pname, meta in metas:
+            mgr.resolve_implicit_meta(pname, meta)
+        return mgr
+
+    # -- conveniences used by orderer/peer wiring ------------------------
+    def batch_config(self):
+        from fabric_mod_tpu.orderer.blockcutter import BatchConfig
+        oc = self.orderer
+        if oc is None:
+            raise ConfigError("no orderer section in channel config")
+        return BatchConfig(
+            max_message_count=oc.batch_size.max_message_count,
+            absolute_max_bytes=oc.batch_size.absolute_max_bytes,
+            preferred_max_bytes=oc.batch_size.preferred_max_bytes,
+            batch_timeout_s=oc.batch_timeout_s)
+
+    def policy(self, path: str):
+        return self.policy_manager.get_policy(path)

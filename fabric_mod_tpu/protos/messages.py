@@ -476,10 +476,12 @@ class RangeQueryInfo(Msg):
 class KVRWSet(Msg):
     FIELDS = ((1, "reads", [("m", "KVRead")]),
               (2, "range_queries_info", [("m", "RangeQueryInfo")]),
-              (3, "writes", [("m", "KVWrite")]))
+              (3, "writes", [("m", "KVWrite")]),
+              (4, "metadata_writes", [("m", "KVMetadataWrite")]))
     reads: List[KVRead] = _f(default_factory=list)
     range_queries_info: List[RangeQueryInfo] = _f(default_factory=list)
     writes: List[KVWrite] = _f(default_factory=list)
+    metadata_writes: List["KVMetadataWrite"] = _f(default_factory=list)
 
 
 @message
@@ -495,3 +497,410 @@ class TxReadWriteSet(Msg):
               (2, "ns_rwset", [("m", "NsReadWriteSet")]))
     data_model: int = 0
     ns_rwset: List[NsReadWriteSet] = _f(default_factory=list)
+
+
+# --- common/configtx.proto -------------------------------------------------
+# Proto maps are repeated {key, value} entry messages on the wire; the
+# channelconfig layer converts to/from dicts and keeps entries sorted by
+# key so encodings stay deterministic (wire.py's consensus requirement).
+
+@message
+class ConfigSignature(Msg):
+    FIELDS = ((1, "signature_header", "b"), (2, "signature", "b"))
+    signature_header: bytes = b""
+    signature: bytes = b""
+
+
+@message
+class ConfigUpdateEnvelope(Msg):
+    FIELDS = ((1, "config_update", "b"),
+              (2, "signatures", [("m", "ConfigSignature")]))
+    config_update: bytes = b""  # ConfigUpdate bytes
+    signatures: List[ConfigSignature] = _f(default_factory=list)
+
+
+@message
+class ConfigGroupEntry(Msg):
+    FIELDS = ((1, "key", "s"), (2, "value", ("m", "ConfigGroup")))
+    key: str = ""
+    value: Optional["ConfigGroup"] = None
+
+
+@message
+class ConfigValueEntry(Msg):
+    FIELDS = ((1, "key", "s"), (2, "value", ("m", "ConfigValue")))
+    key: str = ""
+    value: Optional["ConfigValue"] = None
+
+
+@message
+class ConfigPolicyEntry(Msg):
+    FIELDS = ((1, "key", "s"), (2, "value", ("m", "ConfigPolicy")))
+    key: str = ""
+    value: Optional["ConfigPolicy"] = None
+
+
+@message
+class ConfigGroup(Msg):
+    FIELDS = ((1, "version", "u"),
+              (2, "groups", [("m", "ConfigGroupEntry")]),
+              (3, "values", [("m", "ConfigValueEntry")]),
+              (4, "policies", [("m", "ConfigPolicyEntry")]),
+              (5, "mod_policy", "s"))
+    version: int = 0
+    groups: List[ConfigGroupEntry] = _f(default_factory=list)
+    values: List[ConfigValueEntry] = _f(default_factory=list)
+    policies: List[ConfigPolicyEntry] = _f(default_factory=list)
+    mod_policy: str = ""
+
+
+@message
+class ConfigValue(Msg):
+    FIELDS = ((1, "version", "u"), (2, "value", "b"), (3, "mod_policy", "s"))
+    version: int = 0
+    value: bytes = b""
+    mod_policy: str = ""
+
+
+@message
+class ConfigPolicy(Msg):
+    FIELDS = ((1, "version", "u"), (2, "policy", ("m", "Policy")),
+              (3, "mod_policy", "s"))
+    version: int = 0
+    policy: Optional[Policy] = None
+    mod_policy: str = ""
+
+
+@message
+class Config(Msg):
+    FIELDS = ((1, "sequence", "u"), (2, "channel_group", ("m", "ConfigGroup")))
+    sequence: int = 0
+    channel_group: Optional[ConfigGroup] = None
+
+
+@message
+class ConfigEnvelope(Msg):
+    FIELDS = ((1, "config", ("m", "Config")), (2, "last_update", ("m", "Envelope")))
+    config: Optional[Config] = None
+    last_update: Optional[Envelope] = None
+
+
+@message
+class ConfigUpdate(Msg):
+    FIELDS = ((1, "channel_id", "s"), (2, "read_set", ("m", "ConfigGroup")),
+              (3, "write_set", ("m", "ConfigGroup")))
+    channel_id: str = ""
+    read_set: Optional[ConfigGroup] = None
+    write_set: Optional[ConfigGroup] = None
+
+
+# --- common/configuration.proto + orderer/configuration.proto values -------
+
+@message
+class HashingAlgorithm(Msg):
+    FIELDS = ((1, "name", "s"),)
+    name: str = ""
+
+
+@message
+class BlockDataHashingStructure(Msg):
+    FIELDS = ((1, "width", "u"),)
+    width: int = 0
+
+
+@message
+class OrdererAddresses(Msg):
+    FIELDS = ((1, "addresses", ["s"]),)
+    addresses: List[str] = _f(default_factory=list)
+
+
+@message
+class Capability(Msg):
+    FIELDS = ()
+
+
+@message
+class CapabilityEntry(Msg):
+    FIELDS = ((1, "key", "s"), (2, "value", ("m", "Capability")))
+    key: str = ""
+    value: Optional[Capability] = None
+
+
+@message
+class Capabilities(Msg):
+    FIELDS = ((1, "capabilities", [("m", "CapabilityEntry")]),)
+    capabilities: List[CapabilityEntry] = _f(default_factory=list)
+
+
+@message
+class BatchSize(Msg):
+    FIELDS = ((1, "max_message_count", "u"), (2, "absolute_max_bytes", "u"),
+              (3, "preferred_max_bytes", "u"))
+    max_message_count: int = 0
+    absolute_max_bytes: int = 0
+    preferred_max_bytes: int = 0
+
+
+@message
+class BatchTimeout(Msg):
+    FIELDS = ((1, "timeout", "s"),)   # duration string, e.g. "2s"
+    timeout: str = ""
+
+
+@message
+class ConsensusType(Msg):
+    FIELDS = ((1, "type", "s"), (2, "metadata", "b"), (3, "state", "i"))
+    type: str = ""
+    metadata: bytes = b""
+    state: int = 0
+
+
+# --- msp/msp_config.proto --------------------------------------------------
+
+@message
+class FabricOUIdentifier(Msg):
+    FIELDS = ((1, "certificate", "b"),
+              (2, "organizational_unit_identifier", "s"))
+    certificate: bytes = b""
+    organizational_unit_identifier: str = ""
+
+
+@message
+class FabricNodeOUs(Msg):
+    FIELDS = ((1, "enable", "u"),
+              (2, "client_ou_identifier", ("m", "FabricOUIdentifier")),
+              (3, "peer_ou_identifier", ("m", "FabricOUIdentifier")),
+              (4, "admin_ou_identifier", ("m", "FabricOUIdentifier")),
+              (5, "orderer_ou_identifier", ("m", "FabricOUIdentifier")))
+    enable: int = 0
+    client_ou_identifier: Optional[FabricOUIdentifier] = None
+    peer_ou_identifier: Optional[FabricOUIdentifier] = None
+    admin_ou_identifier: Optional[FabricOUIdentifier] = None
+    orderer_ou_identifier: Optional[FabricOUIdentifier] = None
+
+
+@message
+class FabricMSPConfig(Msg):
+    FIELDS = ((1, "name", "s"), (2, "root_certs", ["b"]),
+              (3, "intermediate_certs", ["b"]), (4, "admins", ["b"]),
+              (5, "revocation_list", ["b"]),
+              (11, "fabric_node_ous", ("m", "FabricNodeOUs")))
+    name: str = ""
+    root_certs: List[bytes] = _f(default_factory=list)      # PEM
+    intermediate_certs: List[bytes] = _f(default_factory=list)
+    admins: List[bytes] = _f(default_factory=list)
+    revocation_list: List[bytes] = _f(default_factory=list)  # DER CRLs
+    fabric_node_ous: Optional[FabricNodeOUs] = None
+
+
+@message
+class MSPConfig(Msg):
+    FIELDS = ((1, "type", "i"), (2, "config", "b"))
+    type: int = 0               # 0 = FABRIC (X.509)
+    config: bytes = b""         # FabricMSPConfig bytes
+
+
+# --- key-level validation metadata (ledger/rwset kvrwset.proto) ------------
+
+@message
+class KVMetadataEntry(Msg):
+    FIELDS = ((1, "name", "s"), (2, "value", "b"))
+    name: str = ""
+    value: bytes = b""
+
+
+@message
+class KVMetadataWrite(Msg):
+    FIELDS = ((1, "key", "s"), (2, "entries", [("m", "KVMetadataEntry")]))
+    key: str = ""
+    entries: List[KVMetadataEntry] = _f(default_factory=list)
+
+
+# --- chaincode lifecycle definition (the committed state record the
+# --- validation-info provider resolves; reference: core/chaincode/
+# --- lifecycle's namespaces/fields state keys, collapsed to one record) ----
+
+@message
+class ChaincodeDefinition(Msg):
+    FIELDS = ((1, "sequence", "u"), (2, "version", "s"),
+              (3, "endorsement_policy", "b"),
+              (4, "validation_plugin", "s"), (5, "init_required", "u"))
+    sequence: int = 0
+    version: str = ""
+    endorsement_policy: bytes = b""     # ApplicationPolicy bytes
+    validation_plugin: str = ""
+    init_required: int = 0
+
+
+# --- orderer/ab.proto (broadcast/deliver service messages) -----------------
+
+class Status:
+    # common/common.proto Status (the HTTP-ish codes the reference uses)
+    UNKNOWN = 0
+    SUCCESS = 200
+    BAD_REQUEST = 400
+    FORBIDDEN = 403
+    NOT_FOUND = 404
+    REQUEST_ENTITY_TOO_LARGE = 413
+    INTERNAL_SERVER_ERROR = 500
+    NOT_IMPLEMENTED = 501
+    SERVICE_UNAVAILABLE = 503
+
+
+@message
+class BroadcastResponse(Msg):
+    FIELDS = ((1, "status", "i"), (2, "info", "s"))
+    status: int = 0
+    info: str = ""
+
+
+@message
+class SeekNewest(Msg):
+    FIELDS = ()
+
+
+@message
+class SeekOldest(Msg):
+    FIELDS = ()
+
+
+@message
+class SeekSpecified(Msg):
+    FIELDS = ((1, "number", "u"),)
+    number: int = 0
+
+
+@message
+class SeekPosition(Msg):
+    # oneof: newest / oldest / specified
+    FIELDS = ((1, "newest", ("m", "SeekNewest")),
+              (2, "oldest", ("m", "SeekOldest")),
+              (3, "specified", ("m", "SeekSpecified")))
+    newest: Optional[SeekNewest] = None
+    oldest: Optional[SeekOldest] = None
+    specified: Optional[SeekSpecified] = None
+
+
+class SeekBehavior:
+    BLOCK_UNTIL_READY = 0
+    FAIL_IF_NOT_READY = 1
+
+
+@message
+class SeekInfo(Msg):
+    FIELDS = ((1, "start", ("m", "SeekPosition")),
+              (2, "stop", ("m", "SeekPosition")),
+              (3, "behavior", "i"))
+    start: Optional[SeekPosition] = None
+    stop: Optional[SeekPosition] = None
+    behavior: int = 0
+
+
+@message
+class DeliverResponse(Msg):
+    # oneof: status / block
+    FIELDS = ((1, "status", "i"), (2, "block", ("m", "Block")))
+    status: int = 0
+    block: Optional[Block] = None
+
+
+# --- gossip/message.proto (the epidemic layer's wire messages) -------------
+
+@message
+class GossipMember(Msg):
+    FIELDS = ((1, "endpoint", "s"), (2, "metadata", "b"),
+              (3, "pki_id", "b"))
+    endpoint: str = ""
+    metadata: bytes = b""
+    pki_id: bytes = b""
+
+
+@message
+class PeerTime(Msg):
+    FIELDS = ((1, "inc_num", "u"), (2, "seq_num", "u"))
+    inc_num: int = 0            # process incarnation (boot time)
+    seq_num: int = 0            # monotonic within incarnation
+
+
+@message
+class AliveMessage(Msg):
+    FIELDS = ((1, "membership", ("m", "GossipMember")),
+              (2, "timestamp", ("m", "PeerTime")),
+              (4, "identity", "b"))
+    membership: Optional[GossipMember] = None
+    timestamp: Optional[PeerTime] = None
+    identity: bytes = b""       # SerializedIdentity
+
+
+@message
+class GossipPayload(Msg):
+    FIELDS = ((1, "seq_num", "u"), (2, "data", "b"))
+    seq_num: int = 0            # block number
+    data: bytes = b""           # Block bytes
+
+
+@message
+class DataMessage(Msg):
+    FIELDS = ((1, "payload", ("m", "GossipPayload")),)
+    payload: Optional[GossipPayload] = None
+
+
+@message
+class GossipHello(Msg):
+    FIELDS = ((1, "nonce", "u"), (2, "metadata", "b"), (3, "msg_type", "i"))
+    nonce: int = 0
+    metadata: bytes = b""
+    msg_type: int = 0
+
+
+@message
+class DataDigest(Msg):
+    FIELDS = ((1, "nonce", "u"), (2, "digests", ["b"]), (3, "msg_type", "i"))
+    nonce: int = 0
+    digests: List[bytes] = _f(default_factory=list)
+    msg_type: int = 0
+
+
+@message
+class DataRequest(Msg):
+    FIELDS = ((1, "nonce", "u"), (2, "digests", ["b"]), (3, "msg_type", "i"))
+    nonce: int = 0
+    digests: List[bytes] = _f(default_factory=list)
+    msg_type: int = 0
+
+
+@message
+class DataUpdate(Msg):
+    FIELDS = ((1, "nonce", "u"), (2, "data", [("m", "GossipEnvelope")]),
+              (3, "msg_type", "i"))
+    nonce: int = 0
+    data: List["GossipEnvelope"] = _f(default_factory=list)
+    msg_type: int = 0
+
+
+@message
+class GossipMessage(Msg):
+    # oneof payload: alive/data/hello/digest/request/update
+    FIELDS = ((1, "nonce", "u"), (2, "channel", "b"), (3, "tag", "i"),
+              (5, "alive_msg", ("m", "AliveMessage")),
+              (6, "data_msg", ("m", "DataMessage")),
+              (7, "hello", ("m", "GossipHello")),
+              (8, "data_dig", ("m", "DataDigest")),
+              (9, "data_req", ("m", "DataRequest")),
+              (10, "data_update", ("m", "DataUpdate")))
+    nonce: int = 0
+    channel: bytes = b""
+    tag: int = 0
+    alive_msg: Optional[AliveMessage] = None
+    data_msg: Optional[DataMessage] = None
+    hello: Optional[GossipHello] = None
+    data_dig: Optional[DataDigest] = None
+    data_req: Optional[DataRequest] = None
+    data_update: Optional[DataUpdate] = None
+
+
+@message
+class GossipEnvelope(Msg):
+    FIELDS = ((1, "payload", "b"), (2, "signature", "b"))
+    payload: bytes = b""        # GossipMessage bytes
+    signature: bytes = b""

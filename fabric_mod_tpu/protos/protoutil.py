@@ -194,3 +194,61 @@ def tx_rwset_and_endorsements(action: m.TransactionAction):
     prp = m.ProposalResponsePayload.decode(prp_bytes)
     cca = m.ChaincodeAction.decode(prp.extension)
     return cca, prp_bytes, cap.action.endorsements
+
+
+# --- proposals (the endorsement flow) --------------------------------------
+
+def create_chaincode_proposal(channel_id: str, chaincode_ns: str,
+                              args: Sequence[bytes], creator
+                              ) -> "tuple[m.SignedProposal, m.Proposal, str]":
+    """Client-side proposal construction + signature
+    (reference: protoutil/proputils.go CreateChaincodeProposal +
+    GetSignedProposal).  Returns (signed_proposal, proposal, tx_id)."""
+    nonce = new_nonce()
+    creator_bytes = creator.serialize()
+    tx_id = compute_tx_id(nonce, creator_bytes)
+    cis = m.ChaincodeInvocationSpec(chaincode_spec=m.ChaincodeSpec(
+        chaincode_id=m.ChaincodeID(name=chaincode_ns),
+        input=m.ChaincodeInput(args=list(args))))
+    ext = m.ChaincodeHeaderExtension(
+        chaincode_id=m.ChaincodeID(name=chaincode_ns))
+    ch = make_channel_header(m.HeaderType.ENDORSER_TRANSACTION, channel_id,
+                             tx_id=tx_id)
+    ch.extension = ext.encode()
+    sh = make_signature_header(creator_bytes, nonce)
+    header = m.Header(channel_header=ch.encode(),
+                      signature_header=sh.encode())
+    ccpp = m.ChaincodeProposalPayload(input=cis.encode())
+    prop = m.Proposal(header=header.encode(), payload=ccpp.encode())
+    prop_bytes = prop.encode()
+    sp = m.SignedProposal(proposal_bytes=prop_bytes,
+                          signature=creator.sign_message(prop_bytes))
+    return sp, prop, tx_id
+
+
+def create_tx_from_responses(prop: m.Proposal,
+                             responses: "Sequence[m.ProposalResponse]",
+                             creator) -> m.Envelope:
+    """Assemble the transaction envelope from a proposal and the
+    endorsers' responses (reference: protoutil/txutils.go
+    CreateSignedTx — requires all response payloads identical)."""
+    if not responses:
+        raise ValueError("no proposal responses")
+    prp_bytes = responses[0].payload
+    for r in responses[1:]:
+        if r.payload != prp_bytes:
+            raise ValueError("proposal response payloads differ")
+    for r in responses:
+        if r.response is None or r.response.status != 200:
+            raise ValueError("endorsement failed: "
+                             f"{r.response.message if r.response else '?'}")
+    header = m.Header.decode(prop.header)
+    cap = m.ChaincodeActionPayload(
+        chaincode_proposal_payload=prop.payload,
+        action=m.ChaincodeEndorsedAction(
+            proposal_response_payload=prp_bytes,
+            endorsements=[r.endorsement for r in responses]))
+    tx = m.Transaction(actions=[m.TransactionAction(
+        header=header.signature_header, payload=cap.encode())])
+    payload = m.Payload(header=header, data=tx.encode())
+    return sign_envelope(payload, creator)
