@@ -1,0 +1,9 @@
+"""The ordering service (reference: orderer/)."""
+from fabric_mod_tpu.orderer.blockcutter import BatchConfig, BlockCutter  # noqa: F401
+from fabric_mod_tpu.orderer.blockwriter import BlockWriter               # noqa: F401
+from fabric_mod_tpu.orderer.broadcast import Broadcast, BroadcastError   # noqa: F401
+from fabric_mod_tpu.orderer.consensus import SoloChain                   # noqa: F401
+from fabric_mod_tpu.orderer.deliver import DeliverService                # noqa: F401
+from fabric_mod_tpu.orderer.msgprocessor import (                        # noqa: F401
+    MsgRejectedError, StandardChannelProcessor)
+from fabric_mod_tpu.orderer.registrar import ChainSupport, Registrar     # noqa: F401

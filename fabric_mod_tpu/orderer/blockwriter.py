@@ -1,0 +1,105 @@
+"""Block creation + signing + append for the ordering service.
+
+(reference: orderer/common/multichannel/blockwriter.go —
+CreateNextBlock at :67, WriteBlock at :168, addBlockSignature at :191
+— and the LAST_CONFIG tracking the deliver client depends on.)
+
+The orderer's signature lives in block metadata[SIGNATURES] as a
+Metadata message whose value carries the last-config index; the signed
+bytes are value ‖ signature_header ‖ encoded block header, so any
+tampering with the data hash chain or the metadata breaks the
+signature.  Peers verify it against the channel's
+/Channel/Orderer/BlockValidation policy before committing (the MCS
+seam, peer/mcs.py).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+from fabric_mod_tpu.ledger.blkstorage import BlockStore
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+
+
+def block_signed_data(block: m.Block, md_value: bytes,
+                      sig_header: bytes) -> bytes:
+    """The exact bytes an orderer signs over a block (and a peer
+    verifies): metadata value ‖ signature header ‖ block header."""
+    return md_value + sig_header + block.header.encode()
+
+
+def last_config_index(block: m.Block) -> Optional[int]:
+    """Read the last-config pointer out of a committed block's
+    SIGNATURES metadata (None if absent/unparseable)."""
+    md = block.metadata.metadata if block.metadata else []
+    idx = m.BlockMetadataIndex.SIGNATURES
+    if len(md) <= idx or not md[idx]:
+        return None
+    try:
+        meta = m.Metadata.decode(md[idx])
+        return m.LastConfig.decode(meta.value).index
+    except Exception:
+        return None
+
+
+class BlockWriter:
+    """Creates, signs, and appends blocks for one channel."""
+
+    def __init__(self, store: BlockStore, signer, channel_id: str):
+        self._store = store
+        self._signer = signer
+        self.channel_id = channel_id
+        self._lock = threading.Lock()
+        self.height_changed = threading.Condition()
+        # Recover last-config pointer from the tip (reference:
+        # blockwriter newBlockWriter reads lastConfigBlockNum)
+        self._last_config = 0
+        h = store.height
+        if h > 0:
+            tip = store.get_block_by_number(h - 1)
+            lc = last_config_index(tip)
+            if lc is not None:
+                self._last_config = lc
+
+    # -- creation --------------------------------------------------------
+    def create_next_block(self, envs: Sequence[m.Envelope]) -> m.Block:
+        """(reference: blockwriter.go:67 CreateNextBlock)"""
+        h = self._store.height
+        prev = self._store.last_block_hash if h else b""
+        return protoutil.new_block(h, prev, envs)
+
+    # -- commit ----------------------------------------------------------
+    def write_block(self, block: m.Block, is_config: bool = False) -> None:
+        """Sign metadata and append (reference: blockwriter.go:168
+        WriteBlock + :191 addBlockSignature).  Caller threads must not
+        interleave create/write pairs; the consenter loop is the only
+        writer (solo/raft both single-threaded), the lock is a guard."""
+        with self._lock:
+            if is_config:
+                self._last_config = block.header.number
+            md_value = m.LastConfig(index=self._last_config).encode()
+            sigs = []
+            if self._signer is not None:
+                sig_header = protoutil.make_signature_header(
+                    self._signer.serialize(), protoutil.new_nonce()).encode()
+                signed = block_signed_data(block, md_value, sig_header)
+                sigs.append(m.MetadataSignature(
+                    signature_header=sig_header,
+                    signature=self._signer.sign_message(signed)))
+            meta = m.Metadata(value=md_value, signatures=sigs)
+            md = block.metadata.metadata
+            while len(md) <= m.BlockMetadataIndex.SIGNATURES:
+                md.append(b"")
+            md[m.BlockMetadataIndex.SIGNATURES] = meta.encode()
+            self._store.add_block(block)
+        with self.height_changed:
+            self.height_changed.notify_all()
+
+    @property
+    def height(self) -> int:
+        return self._store.height
+
+    @property
+    def last_config(self) -> int:
+        return self._last_config

@@ -1,0 +1,52 @@
+"""Broadcast ingress: envelope in, routed + validated + ordered.
+
+(reference: orderer/common/broadcast/broadcast.go — Handle at :66
+receiving the stream, ProcessMessage at :136-180 doing classify →
+msgprocessor → WaitReady → Order/Configure.)
+
+In-process this round: `Broadcast.submit` is the unary equivalent of
+one stream message; the gRPC server wraps this same object when the
+comm layer lands (SURVEY §5.8 keeps gRPC as the control plane).
+"""
+from __future__ import annotations
+
+from fabric_mod_tpu.channelconfig import ConfigTxError
+from fabric_mod_tpu.orderer.msgprocessor import MsgRejectedError
+from fabric_mod_tpu.orderer.registrar import Registrar
+from fabric_mod_tpu.protos import messages as m
+
+# client-attributable rejections -> BAD_REQUEST on the wire; anything
+# else propagates as an internal error (the gRPC handler maps it to
+# INTERNAL_SERVER_ERROR) — misattributing bugs to clients masks them
+_CLIENT_FAULTS = (MsgRejectedError, ConfigTxError, ValueError)
+
+
+class BroadcastError(Exception):
+    pass
+
+
+class Broadcast:
+    def __init__(self, registrar: Registrar):
+        self._registrar = registrar
+
+    def submit(self, env: m.Envelope) -> None:
+        """Accept one envelope for ordering; raises BroadcastError on
+        client-caused rejection (maps to BAD_REQUEST on the wire)."""
+        try:
+            support, is_config_update = \
+                self._registrar.broadcast_channel_support(env)
+        except Exception as e:
+            raise BroadcastError(f"routing: {e}") from e
+        if is_config_update:
+            try:
+                wrapped, seq = \
+                    support.processor.process_config_update_msg(env)
+            except _CLIENT_FAULTS as e:
+                raise BroadcastError(f"config update rejected: {e}") from e
+            support.chain.configure(wrapped, seq)
+        else:
+            try:
+                seq = support.processor.process_normal_msg(env)
+            except _CLIENT_FAULTS as e:
+                raise BroadcastError(f"rejected: {e}") from e
+            support.chain.order(env, seq)

@@ -1,0 +1,128 @@
+"""Consenters: the ordering loop that turns envelopes into blocks.
+
+(reference: orderer/consensus/solo/consensus.go:183 — the single
+goroutine select loop over normal/config messages and the batch
+timer — and the consenter contract in orderer/consensus/consensus.go.)
+
+`SoloChain` is the dev/single-node consenter: one worker thread drains
+an ingress queue, feeds the block cutter, owns the batch timer, and
+drives the block writer.  Config envelopes cut the pending batch and
+ride alone in their own block, after which the chain support swaps the
+channel bundle — identical ordering semantics to the reference's solo,
+with the queue standing in for the Go channel select.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+from fabric_mod_tpu.protos import messages as m
+
+
+class ChainHaltedError(Exception):
+    pass
+
+
+class _Msg:
+    __slots__ = ("env", "is_config", "config_seq")
+
+    def __init__(self, env: m.Envelope, is_config: bool, config_seq: int):
+        self.env = env
+        self.is_config = is_config
+        self.config_seq = config_seq
+
+
+class SoloChain:
+    """Single-node consenter (reference: solo/consensus.go:183).
+
+    `support` provides: cutter (BlockCutter), writer (BlockWriter),
+    batch_timeout_s(), process_config(env) -> applies the config and
+    returns None, and reprocess hooks when config_seq went stale.
+    """
+
+    def __init__(self, support):
+        self._support = support
+        self._q: "queue.Queue[Optional[_Msg]]" = queue.Queue(maxsize=10_000)
+        self._halted = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    # -- consenter API (reference: consensus.go Order/Configure) ---------
+    def start(self) -> None:
+        self._thread.start()
+
+    def halt(self) -> None:
+        if self._halted.is_set():
+            return
+        self._halted.set()
+        self._q.put(None)                 # wake the loop
+        self._thread.join(timeout=10)
+
+    def wait_ready(self) -> None:
+        """Backpressure point (reference: WaitReady) — solo accepts
+        whenever the queue has room; Queue.put blocks if full."""
+        if self._halted.is_set():
+            raise ChainHaltedError("chain is halted")
+
+    def order(self, env: m.Envelope, config_seq: int) -> None:
+        self.wait_ready()
+        self._q.put(_Msg(env, False, config_seq))
+
+    def configure(self, env: m.Envelope, config_seq: int) -> None:
+        self.wait_ready()
+        self._q.put(_Msg(env, True, config_seq))
+
+    # -- the ordering loop ----------------------------------------------
+    def _run(self) -> None:
+        support = self._support
+        timer_deadline: Optional[float] = None
+        import time
+        while not self._halted.is_set():
+            timeout = None
+            if timer_deadline is not None:
+                timeout = max(0.0, timer_deadline - time.monotonic())
+            try:
+                msg = self._q.get(timeout=timeout)
+            except queue.Empty:
+                # batch timer fired (reference: case <-timer)
+                timer_deadline = None
+                batch = support.cutter.cut()
+                if batch:
+                    block = support.writer.create_next_block(batch)
+                    support.writer.write_block(block)
+                continue
+            if msg is None:
+                break
+            if msg.is_config:
+                # config messages cut pending and ride alone
+                # (reference: solo consensus.go config branch)
+                if msg.config_seq < support.sequence():
+                    # stale validation: reprocess under current config
+                    try:
+                        msg = _Msg(*support.reprocess_config(msg.env))
+                    except Exception:
+                        continue          # rejected under new config
+                batch = support.cutter.cut()
+                if batch:
+                    block = support.writer.create_next_block(batch)
+                    support.writer.write_block(block)
+                    timer_deadline = None
+                block = support.writer.create_next_block([msg.env])
+                support.process_config(msg.env, block)
+                continue
+            if msg.config_seq < support.sequence():
+                try:
+                    support.revalidate_normal(msg.env)
+                except Exception:
+                    continue              # rejected under new config
+            batches, pending = support.cutter.ordered(msg.env)
+            for batch in batches:
+                block = support.writer.create_next_block(batch)
+                support.writer.write_block(block)
+            if batches:
+                timer_deadline = None
+            if pending and timer_deadline is None:
+                timer_deadline = (time.monotonic()
+                                  + support.batch_timeout_s())
+        # drain-free halt: pending messages are dropped like the
+        # reference's Halt (clients resubmit after failover)

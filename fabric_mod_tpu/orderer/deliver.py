@@ -1,0 +1,45 @@
+"""Deliver: stream committed blocks to consumers.
+
+(reference: common/deliver/deliver.go — Handle at :157, deliverBlocks
+at :199 with SeekInfo semantics — serving the peer's deliver client,
+blocksprovider.go.)
+
+In-process this round: `DeliverService.blocks` is a generator with the
+reference's seek semantics (start position, optional stop, block on
+newest).  The gRPC streaming wrapper rides on top unchanged later.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Iterator, Optional
+
+from fabric_mod_tpu.orderer.registrar import ChainSupport
+from fabric_mod_tpu.protos import messages as m
+
+
+class DeliverService:
+    def __init__(self, support: ChainSupport):
+        self._support = support
+
+    def blocks(self, start: int = 0, stop: Optional[int] = None,
+               stop_event: Optional[threading.Event] = None,
+               timeout_s: float = 30.0) -> Iterator[m.Block]:
+        """Yield blocks [start, stop]; when the chain tip is reached,
+        block until new blocks arrive (SeekInfo BLOCK_UNTIL_READY) or
+        `stop_event` fires / `timeout_s` elapses without progress."""
+        num = start
+        store = self._support.store
+        cond = self._support.writer.height_changed
+        while stop is None or num <= stop:
+            if stop_event is not None and stop_event.is_set():
+                return
+            blk = store.get_block_by_number(num)
+            if blk is not None:
+                yield blk
+                num += 1
+                continue
+            with cond:
+                if store.height > num:
+                    continue              # raced a write; re-read
+                if not cond.wait(timeout=timeout_s):
+                    return                # idle timeout: end the stream

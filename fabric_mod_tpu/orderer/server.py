@@ -1,0 +1,122 @@
+"""The orderer's gRPC surface: AtomicBroadcast.Broadcast/Deliver.
+
+(reference: orderer/common/server — NewServer at server.go:210
+registering AtomicBroadcast over internal/pkg/comm's mTLS server;
+broadcast.go:66 Handle and common/deliver/deliver.go:157 Handle are
+the two stream loops.)
+
+Wire contract: envelopes/seek-infos/responses are this framework's
+deterministic encodings travelling as gRPC byte payloads
+(comm/grpc_comm.py's generic handlers).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Iterator, Optional
+
+from fabric_mod_tpu.comm.grpc_comm import GRPCServer, MethodKind
+from fabric_mod_tpu.orderer.broadcast import Broadcast, BroadcastError
+from fabric_mod_tpu.orderer.deliver import DeliverService
+from fabric_mod_tpu.orderer.registrar import Registrar
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+
+SERVICE = "orderer.AtomicBroadcast"
+
+
+class OrdererServer:
+    """Binds a Registrar to a gRPC listener."""
+
+    def __init__(self, registrar: Registrar, address: str = "127.0.0.1:0",
+                 server_cert_pem: Optional[bytes] = None,
+                 server_key_pem: Optional[bytes] = None,
+                 client_root_pem: Optional[bytes] = None):
+        self._registrar = registrar
+        self._broadcast = Broadcast(registrar)
+        self._grpc = GRPCServer(address, server_cert_pem,
+                                server_key_pem, client_root_pem)
+        self.port = self._grpc.port
+        self._grpc.register(SERVICE, "Broadcast",
+                            MethodKind.STREAM_STREAM, self._handle_broadcast)
+        self._grpc.register(SERVICE, "Deliver",
+                            MethodKind.STREAM_STREAM, self._handle_deliver)
+
+    def start(self) -> None:
+        self._grpc.start()
+
+    def stop(self) -> None:
+        self._grpc.stop()
+
+    # -- Broadcast stream (reference: broadcast.go:66) -------------------
+    def _handle_broadcast(self, request_iter, context) -> Iterator[bytes]:
+        for raw in request_iter:
+            try:
+                env = m.Envelope.decode(raw)
+                self._broadcast.submit(env)
+                resp = m.BroadcastResponse(status=m.Status.SUCCESS)
+            except BroadcastError as e:
+                resp = m.BroadcastResponse(
+                    status=m.Status.BAD_REQUEST, info=str(e))
+            except Exception as e:
+                resp = m.BroadcastResponse(
+                    status=m.Status.INTERNAL_SERVER_ERROR, info=str(e))
+            yield resp.encode()
+
+    # -- Deliver stream (reference: deliver.go:157-199) ------------------
+    def _handle_deliver(self, request_iter, context) -> Iterator[bytes]:
+        for raw in request_iter:
+            try:
+                env = m.Envelope.decode(raw)
+                payload = protoutil.unmarshal_envelope_payload(env)
+                ch = m.ChannelHeader.decode(payload.header.channel_header)
+                seek = m.SeekInfo.decode(payload.data)
+            except Exception:
+                yield m.DeliverResponse(
+                    status=m.Status.BAD_REQUEST).encode()
+                return
+            support = self._registrar.get_chain(ch.channel_id)
+            if support is None:
+                yield m.DeliverResponse(
+                    status=m.Status.NOT_FOUND).encode()
+                return
+            svc = DeliverService(support)
+            start = self._seek_number(seek.start, support, newest_tip=True)
+            stop = self._seek_number(seek.stop, support, newest_tip=False)
+            stop_event = threading.Event()
+            cb = context.add_callback(stop_event.set)
+            for block in svc.blocks(start, stop=stop,
+                                    stop_event=stop_event,
+                                    timeout_s=30.0):
+                yield m.DeliverResponse(block=block).encode()
+            yield m.DeliverResponse(status=m.Status.SUCCESS).encode()
+
+    @staticmethod
+    def _seek_number(pos: Optional[m.SeekPosition], support,
+                     newest_tip: bool) -> Optional[int]:
+        if pos is None:
+            return None
+        if pos.specified is not None:
+            return pos.specified.number
+        if pos.oldest is not None:
+            return 0
+        if pos.newest is not None:
+            h = support.store.height
+            return max(0, h - 1) if newest_tip else None
+        return None if not newest_tip else 0
+
+
+def make_seek_envelope(channel_id: str, start: int,
+                       stop: Optional[int] = None) -> m.Envelope:
+    """Client-side SeekInfo envelope (reference: the deliver client's
+    seekInfo construction in blocksprovider)."""
+    stop_pos = (m.SeekPosition(specified=m.SeekSpecified(number=stop))
+                if stop is not None else None)
+    seek = m.SeekInfo(
+        start=m.SeekPosition(specified=m.SeekSpecified(number=start)),
+        stop=stop_pos,
+        behavior=m.SeekBehavior.BLOCK_UNTIL_READY)
+    ch = protoutil.make_channel_header(
+        m.HeaderType.DELIVER_SEEK_INFO, channel_id)
+    sh = protoutil.make_signature_header(b"", protoutil.new_nonce())
+    payload = protoutil.make_payload(ch, sh, seek.encode())
+    return m.Envelope(payload=payload.encode())

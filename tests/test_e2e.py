@@ -1,0 +1,225 @@
+"""End-to-end slice tests: endorse -> order -> deliver -> verify ->
+validate -> commit, plus config governance and tamper rejection.
+
+(reference test model: integration/e2e/e2e_test.go's full tx flow and
+integration/raft's kill/tamper suites, shrunk to the in-process
+network of fabric_mod_tpu/e2e.py.)
+"""
+import copy
+import threading
+import time
+
+import pytest
+
+from fabric_mod_tpu.channelconfig import (
+    Bundle, compute_update, signed_update_envelope)
+from fabric_mod_tpu.channelconfig.bundle import (
+    APPLICATION, groups_of, policies_of, set_policy)
+from fabric_mod_tpu.channelconfig.configtx import config_from_block
+from fabric_mod_tpu.e2e import Network
+from fabric_mod_tpu.orderer import BroadcastError
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+
+V = m.TxValidationCode
+
+
+@pytest.fixture()
+def net(tmp_path):
+    n = Network(str(tmp_path), batch_timeout="100ms",
+                max_message_count=25)
+    yield n
+    n.close()
+
+
+def _commit_through(net, n_txs, stop_at=None, timeout=20.0):
+    """Run a deliver client until n_txs non-config txs commit."""
+    client = net.deliver_client()
+    t = threading.Thread(target=client.run, daemon=True)
+    t.start()
+    deadline = time.time() + timeout
+    committed = 0
+    while time.time() < deadline:
+        committed = sum(
+            len(net.ledger.get_block_by_number(i).data.data)
+            for i in range(1, net.ledger.height))
+        if committed >= n_txs:
+            break
+        time.sleep(0.02)
+    client.stop()
+    t.join(timeout=5)
+    return committed, client
+
+
+def test_e2e_happy_path(net):
+    txids = [net.invoke([b"put", b"k%d" % i, b"v%d" % i])
+             for i in range(60)]
+    committed, _ = _commit_through(net, 60)
+    assert committed == 60
+    # all flags VALID
+    for i in range(1, net.ledger.height):
+        blk = net.ledger.get_block_by_number(i)
+        assert all(f == V.VALID for f in protoutil.block_txflags(blk))
+    # state applied
+    qe = net.ledger.new_query_executor()
+    assert qe.get_state("mycc", "k7") == b"v7"
+    # txid lookup works through the committed ledger
+    pt = net.ledger.get_transaction_by_id(txids[0])
+    assert pt is not None and pt.validation_code == V.VALID
+
+
+def test_tampered_block_rejected(net):
+    net.invoke([b"put", b"a", b"1"])
+    # wait for the orderer to cut the block
+    deadline = time.time() + 5
+    while net.support.store.height < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    assert net.support.store.height >= 2
+
+    class TamperingSource:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def blocks(self, *a, **kw):
+            for blk in self._inner.blocks(*a, **kw):
+                if blk.header.number >= 1:
+                    blk = copy.deepcopy(blk)
+                    env = m.Envelope.decode(blk.data.data[0])
+                    env.signature = b"\x00" * len(env.signature)
+                    blk.data.data[0] = env.encode()
+                    # keep data_hash consistent so only the orderer
+                    # signature check can catch it
+                    blk.header.data_hash = protoutil.block_data_hash(
+                        blk.data)
+                yield blk
+
+    from fabric_mod_tpu.peer.deliverclient import DeliverClient
+    client = DeliverClient(net.channel, TamperingSource(net.deliver))
+    client.run(stop_at=1, idle_timeout_s=2.0)
+    assert client.rejected == [1]
+    assert net.ledger.height == 1          # nothing committed
+
+
+def test_config_update_changes_endorsement_policy(net):
+    # baseline: 2-of-3 endorsement passes
+    net.invoke([b"put", b"x", b"1"], endorsing_orgs=["Org1", "Org2"])
+    committed, _ = _commit_through(net, 1)
+    assert committed == 1
+
+    # flip /Channel/Application Endorsement meta policy MAJORITY -> ALL
+    cur = net.channel.bundle().config
+    desired = m.ConfigGroup.decode(cur.channel_group.encode())
+    app = groups_of(desired)[APPLICATION]
+    pol = policies_of(app)["Endorsement"]
+    pol.policy = m.Policy(
+        type=m.PolicyType.IMPLICIT_META,
+        value=m.ImplicitMetaPolicy(sub_policy="Endorsement",
+                                   rule=m.ImplicitMetaRule.ALL).encode())
+    set_policy(app, "Endorsement", pol)
+    from fabric_mod_tpu.channelconfig.bundle import set_group
+    set_group(desired, APPLICATION, app)
+    update = compute_update(net.channel_id, cur, desired)
+    # mod policy of the Endorsement policy is Admins ->
+    # /Channel/Application/Admins = MAJORITY of 3 org Admins -> 2 needed
+    env = signed_update_envelope(
+        net.channel_id, update,
+        [net.admins["Org1"], net.admins["Org2"]])
+    net.broadcast.submit(env)
+
+    # a 2-of-3 endorsed tx AFTER the config change must now fail
+    net.invoke([b"put", b"y", b"2"], endorsing_orgs=["Org1", "Org2"])
+    net.invoke([b"put", b"z", b"3"],
+               endorsing_orgs=["Org1", "Org2", "Org3"])
+    # 4 envelopes total: the config tx + the pre/post invokes
+    committed, _ = _commit_through(net, 4, timeout=25.0)
+    assert committed == 4
+
+    # orderer adopted the new config
+    assert net.support.bundle().sequence == 1
+    # peer adopted it too (bundle swap happened in the validator)
+    assert net.channel.bundle().sequence == 1
+
+    flags = []
+    for i in range(1, net.ledger.height):
+        blk = net.ledger.get_block_by_number(i)
+        for env_bytes, f in zip(blk.data.data, protoutil.block_txflags(blk)):
+            ch = protoutil.envelope_channel_header(
+                m.Envelope.decode(env_bytes))
+            flags.append((ch.type, f))
+    # the config tx is VALID; post-config 2-of-3 tx INVALID; 3-of-3 VALID
+    assert (m.HeaderType.CONFIG, V.VALID) in flags
+    post = [f for t, f in flags if t == m.HeaderType.ENDORSER_TRANSACTION]
+    assert post[0] == V.VALID                      # pre-config tx
+    assert V.ENDORSEMENT_POLICY_FAILURE in post[1:]
+    assert post[-1] == V.VALID or post[-2] == V.VALID  # 3-of-3 passed
+
+
+def test_unauthorized_config_update_rejected(net):
+    cur = net.channel.bundle().config
+    desired = m.ConfigGroup.decode(cur.channel_group.encode())
+    app = groups_of(desired)[APPLICATION]
+    pol = policies_of(app)["Endorsement"]
+    pol.policy = m.Policy(
+        type=m.PolicyType.IMPLICIT_META,
+        value=m.ImplicitMetaPolicy(sub_policy="Endorsement",
+                                   rule=m.ImplicitMetaRule.ANY).encode())
+    set_policy(app, "Endorsement", pol)
+    from fabric_mod_tpu.channelconfig.bundle import set_group
+    set_group(desired, APPLICATION, app)
+    update = compute_update(net.channel_id, cur, desired)
+    # signed by a client + a single admin: MAJORITY(3) needs 2 admins
+    env = signed_update_envelope(
+        net.channel_id, update, [net.admins["Org1"]])
+    with pytest.raises(BroadcastError):
+        net.broadcast.submit(env)
+
+
+def test_forged_config_block_flagged_invalid(net):
+    """A config block that did not come from a validated update is
+    INVALID_CONFIG_TRANSACTION at the peer (fail-closed)."""
+    cur = net.channel.bundle().config
+    forged = m.Config(sequence=cur.sequence + 1,
+                      channel_group=cur.channel_group)
+    # properly signed by a channel member, but with no last_update
+    # authorizing it — the config machinery must reject it
+    cenv = m.ConfigEnvelope(config=forged)
+    ch = protoutil.make_channel_header(m.HeaderType.CONFIG, net.channel_id)
+    sh = protoutil.make_signature_header(
+        net.orderer_signer.serialize(), protoutil.new_nonce())
+    payload = protoutil.make_payload(ch, sh, cenv.encode())
+    env = protoutil.sign_envelope(payload, net.orderer_signer)
+    blk = protoutil.new_block(
+        1, protoutil.block_header_hash(
+            net.ledger.get_block_by_number(0).header), [env])
+    flags = net.channel.validator().validate(blk)
+    assert flags == [V.INVALID_CONFIG_TRANSACTION]
+
+
+def test_batch_size_config_update_applies_to_cutter(net):
+    from fabric_mod_tpu.channelconfig.bundle import (
+        BATCH_SIZE, ORDERER, set_value, values_of)
+    cur = net.channel.bundle().config
+    desired = m.ConfigGroup.decode(cur.channel_group.encode())
+    osec = groups_of(desired)[ORDERER]
+    bs = values_of(osec)[BATCH_SIZE]
+    bs.value = m.BatchSize(max_message_count=7,
+                           absolute_max_bytes=10 * 1024 * 1024,
+                           preferred_max_bytes=2 * 1024 * 1024).encode()
+    set_value(osec, BATCH_SIZE, bs)
+    from fabric_mod_tpu.channelconfig.bundle import set_group
+    set_group(desired, ORDERER, osec)
+    update = compute_update(net.channel_id, cur, desired)
+    # BatchSize mod_policy Admins -> /Channel/Orderer/Admins (orderer org)
+    ocert, okey = net.orderer_ca.issue("admin@orderer", "OrdererOrg",
+                                       ous=["admin"])
+    from fabric_mod_tpu.msp import ca as calib
+    from fabric_mod_tpu.msp.identities import SigningIdentity
+    oadmin = SigningIdentity("OrdererOrg", ocert, calib.key_pem(okey),
+                             net.csp)
+    env = signed_update_envelope(net.channel_id, update, [oadmin])
+    net.broadcast.submit(env)
+    deadline = time.time() + 5
+    while net.support.bundle().sequence == 0 and time.time() < deadline:
+        time.sleep(0.02)
+    assert net.support.bundle().sequence == 1
+    assert net.support.cutter.config.max_message_count == 7
