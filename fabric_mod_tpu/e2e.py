@@ -1,0 +1,176 @@
+"""In-process end-to-end network: the minimum slice, whole loop.
+
+(reference: the integration/nwo "network world order" declarative
+topology builder, network.go:44-60, shrunk to one process: client ->
+endorsers -> broadcast -> solo consenter -> deliver -> MCS verify ->
+validator (device batch) -> MVCC -> commit.)
+
+This is the BASELINE config #3 shape without gRPC between the parts;
+the seams (Broadcast.submit, DeliverService.blocks, verify_many) are
+exactly where the wire goes when the comm layer lands.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional, Sequence
+
+from fabric_mod_tpu.bccsp.sw import SwCSP
+from fabric_mod_tpu.channelconfig import Bundle, genesis
+from fabric_mod_tpu.channelconfig.configtx import config_from_block
+from fabric_mod_tpu.ledger.kvledger import LedgerManager
+from fabric_mod_tpu.msp import ca as calib
+from fabric_mod_tpu.msp.identities import SigningIdentity
+from fabric_mod_tpu.orderer import Broadcast, DeliverService, Registrar
+from fabric_mod_tpu.peer.chaincode import ChaincodeRegistry, KvContract
+from fabric_mod_tpu.peer.channel import Channel
+from fabric_mod_tpu.peer.deliverclient import DeliverClient
+from fabric_mod_tpu.peer.endorser import Endorser, endorse_and_submit
+from fabric_mod_tpu.protos import messages as m
+
+
+class Network:
+    """One channel, N orgs, one solo orderer, one committing peer,
+    one endorser per org — all in-process."""
+
+    def __init__(self, root_dir: str, channel_id: str = "testchannel",
+                 orgs: Sequence[str] = ("Org1", "Org2", "Org3"),
+                 verifier=None, csp=None,
+                 max_message_count: int = 500,
+                 batch_timeout: str = "250ms"):
+        self.channel_id = channel_id
+        self.csp = csp or SwCSP()
+        if verifier is None:
+            from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
+            verifier = FakeBatchVerifier(self.csp)
+        self.verifier = verifier
+
+        # crypto material (the cryptogen step)
+        self.cas: Dict[str, calib.CA] = {
+            org: calib.CA(f"ca.{org.lower()}", org) for org in orgs}
+        self.orderer_ca = calib.CA("ca.orderer", "OrdererOrg")
+        ocert, okey = self.orderer_ca.issue(
+            "orderer0", "OrdererOrg", ous=["orderer"])
+        self.orderer_signer = SigningIdentity(
+            "OrdererOrg", ocert, calib.key_pem(okey), self.csp)
+
+        self.peer_signers: Dict[str, SigningIdentity] = {}
+        for org, ca in self.cas.items():
+            cert, key = ca.issue(f"peer0.{org.lower()}", org, ous=["peer"])
+            self.peer_signers[org] = SigningIdentity(
+                org, cert, calib.key_pem(key), self.csp)
+        first = orgs[0]
+        ccert, ckey = self.cas[first].issue(
+            f"client@{first.lower()}", first, ous=["client"])
+        self.client = SigningIdentity(
+            first, ccert, calib.key_pem(ckey), self.csp)
+        self.admins: Dict[str, SigningIdentity] = {}
+        for org, ca in self.cas.items():
+            acert, akey = ca.issue(f"admin@{org.lower()}", org,
+                                   ous=["admin"])
+            self.admins[org] = SigningIdentity(
+                org, acert, calib.key_pem(akey), self.csp)
+
+        # genesis (the configtxgen step)
+        self.genesis_block = genesis.standard_network(
+            channel_id,
+            {org: [calib.cert_pem(ca.cert)] for org, ca in self.cas.items()},
+            {"OrdererOrg": [calib.cert_pem(self.orderer_ca.cert)]},
+            max_message_count=max_message_count,
+            batch_timeout=batch_timeout)
+
+        # ordering service
+        self.registrar = Registrar(
+            os.path.join(root_dir, "orderer"), self.orderer_signer,
+            self.csp)
+        self.support = self.registrar.create_channel(self.genesis_block)
+        self.broadcast = Broadcast(self.registrar)
+        self.deliver = DeliverService(self.support)
+
+        # the committing peer
+        _, config = config_from_block(self.genesis_block)
+        bundle = Bundle(channel_id, config, self.csp)
+        self.ledger_mgr = LedgerManager(os.path.join(root_dir, "peer"))
+        self.ledger = self.ledger_mgr.create_or_open(channel_id)
+        self.channel = Channel(channel_id, self.ledger, verifier, bundle,
+                               self.csp)
+        if self.ledger.height == 0:
+            self.channel.init_from_genesis(self.genesis_block)
+
+        # chaincode + endorsers
+        from fabric_mod_tpu.peer.lifecycle import (
+            LIFECYCLE_NS, LifecycleContract)
+        self.chaincodes = ChaincodeRegistry()
+        self.chaincodes.register("mycc", KvContract())
+        self.chaincodes.register(LIFECYCLE_NS, LifecycleContract())
+        self.endorsers: Dict[str, Endorser] = {
+            org: Endorser(self.channel, self.chaincodes,
+                          self.peer_signers[org])
+            for org in orgs}
+
+    # -- client operations ------------------------------------------------
+    def invoke(self, args: Sequence[bytes],
+               endorsing_orgs: Optional[Sequence[str]] = None,
+               chaincode: str = "mycc") -> str:
+        orgs = list(endorsing_orgs or list(self.endorsers)[:2])
+        return endorse_and_submit(
+            self.channel_id, chaincode, args, self.client,
+            [self.endorsers[o] for o in orgs], self.broadcast)
+
+    def deliver_client(self, **kw) -> DeliverClient:
+        return DeliverClient(self.channel, self.deliver, **kw)
+
+    def close(self) -> None:
+        self.registrar.close()
+        self.ledger_mgr.close()
+
+
+def run_pipeline(n_txs: int, verifier, reps_unused: int = 1) -> float:
+    """Endorse n_txs txs, broadcast them, commit them through the full
+    peer pipeline; return committed tx/s over the ordering+commit span
+    (endorsement/signing excluded — it is client work)."""
+    with tempfile.TemporaryDirectory() as root:
+        net = Network(root, verifier=verifier)
+        try:
+            # endorse everything up front (client-side work)
+            from fabric_mod_tpu.protos import protoutil
+            envs = []
+            orgs = list(net.endorsers)[:2]
+            for i in range(n_txs):
+                sp, prop, _ = protoutil.create_chaincode_proposal(
+                    net.channel_id, "mycc",
+                    [b"put", b"k%d" % i, b"v%d" % i], net.client)
+                responses = [net.endorsers[o].process_proposal(sp)
+                             for o in orgs]
+                envs.append(protoutil.create_tx_from_responses(
+                    prop, responses, net.client))
+
+            t0 = time.perf_counter()
+            for env in envs:
+                net.broadcast.submit(env)
+            # orderer cuts blocks; peer pulls + commits
+            client = net.deliver_client()
+            import threading
+            runner = threading.Thread(target=client.run, daemon=True)
+            runner.start()
+            # wait until everything committed
+            want = net.ledger.height  # will grow; recompute below
+            deadline = time.time() + max(60.0, n_txs / 50)
+            while time.time() < deadline:
+                committed = sum(
+                    len(b.data.data)
+                    for b in (net.ledger.get_block_by_number(i)
+                              for i in range(1, net.ledger.height))
+                    if b is not None)
+                if committed >= n_txs:
+                    break
+                time.sleep(0.01)
+            dt = time.perf_counter() - t0
+            client.stop()
+            if committed < n_txs:
+                raise RuntimeError(
+                    f"only {committed}/{n_txs} txs committed")
+            return n_txs / dt
+        finally:
+            net.close()

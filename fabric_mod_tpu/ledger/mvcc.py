@@ -112,5 +112,10 @@ def validate_and_prepare_batch(
                 else:
                     batch.put(ns, w.key, w.value, (block_num, tx_num))
                 tx_writes.append((tx_num, ns, w.key))
+            for mw in kv.metadata_writes:
+                batch.put_metadata(
+                    ns, mw.key,
+                    {e.name: e.value for e in mw.entries},
+                    (block_num, tx_num))
         flags.append(m.TxValidationCode.VALID)
     return flags, batch, tx_writes

@@ -53,12 +53,19 @@ class RWSetBuilder:
         self._reads: Dict[str, Dict[str, Optional[Version]]] = {}
         self._writes: Dict[str, Dict[str, Optional[bytes]]] = {}
         self._ranges: Dict[str, List[m.RangeQueryInfo]] = {}
+        self._meta: Dict[str, Dict[str, Dict[str, bytes]]] = {}
 
     def add_read(self, ns: str, key: str, version: Optional[Version]) -> None:
         self._reads.setdefault(ns, {}).setdefault(key, version)
 
     def add_write(self, ns: str, key: str, value: Optional[bytes]) -> None:
         self._writes.setdefault(ns, {})[key] = value
+
+    def add_metadata_write(self, ns: str, key: str, name: str,
+                           value: bytes) -> None:
+        """(reference: rwset_builder.go AddToMetadataWriteSet — key
+        metadata like the VALIDATION_PARAMETER endorsement override)"""
+        self._meta.setdefault(ns, {}).setdefault(key, {})[name] = value
 
     def add_range_query(self, ns: str, start: str, end: str,
                         exhausted: bool,
@@ -70,7 +77,7 @@ class RWSetBuilder:
     def build(self) -> m.TxReadWriteSet:
         ns_sets = []
         for ns in sorted(set(self._reads) | set(self._writes)
-                         | set(self._ranges)):
+                         | set(self._ranges) | set(self._meta)):
             kv = m.KVRWSet(
                 reads=[m.KVRead(key=k, version=version_proto(v))
                        for k, v in sorted(
@@ -80,7 +87,13 @@ class RWSetBuilder:
                                   is_delete=int(val is None),
                                   value=val or b"")
                         for k, val in sorted(
-                            self._writes.get(ns, {}).items())])
+                            self._writes.get(ns, {}).items())],
+                metadata_writes=[
+                    m.KVMetadataWrite(key=k, entries=[
+                        m.KVMetadataEntry(name=n, value=v)
+                        for n, v in sorted(entries.items())])
+                    for k, entries in sorted(
+                        self._meta.get(ns, {}).items())])
             ns_sets.append(m.NsReadWriteSet(namespace=ns, rwset=kv.encode()))
         return m.TxReadWriteSet(data_model=0, ns_rwset=ns_sets)
 

@@ -26,10 +26,13 @@ Version = Tuple[int, int]               # (block_num, tx_num)
 
 
 class UpdateBatch:
-    """Pending writes of one block (reference: statedb.go UpdateBatch)."""
+    """Pending writes of one block (reference: statedb.go UpdateBatch,
+    incl. the metadata writes key-level endorsement rides on)."""
 
     def __init__(self):
         self.updates: Dict[Tuple[str, str], Tuple[Optional[bytes], Version]] = {}
+        self.meta_updates: Dict[Tuple[str, str],
+                                Tuple[Dict[str, bytes], Version]] = {}
 
     def put(self, ns: str, key: str, value: bytes, version: Version) -> None:
         self.updates[(ns, key)] = (value, version)
@@ -37,11 +40,15 @@ class UpdateBatch:
     def delete(self, ns: str, key: str, version: Version) -> None:
         self.updates[(ns, key)] = (None, version)
 
+    def put_metadata(self, ns: str, key: str, entries: Dict[str, bytes],
+                     version: Version) -> None:
+        self.meta_updates[(ns, key)] = (dict(entries), version)
+
     def get(self, ns: str, key: str):
         return self.updates.get((ns, key))
 
     def __len__(self) -> int:
-        return len(self.updates)
+        return len(self.updates) + len(self.meta_updates)
 
 
 class VersionedDB:
@@ -49,6 +56,7 @@ class VersionedDB:
 
     def __init__(self):
         self._data: Dict[Tuple[str, str], Tuple[bytes, Version]] = {}
+        self._metadata: Dict[Tuple[str, str], Dict[str, bytes]] = {}
         self._keys: Dict[str, List[str]] = {}       # ns -> sorted keys
         self._savepoint: int = -1                   # last committed block
 
@@ -60,6 +68,12 @@ class VersionedDB:
     def get_version(self, ns: str, key: str) -> Optional[Version]:
         got = self._data.get((ns, key))
         return got[1] if got else None
+
+    def get_metadata(self, ns: str, key: str) -> Optional[Dict[str, bytes]]:
+        """Key metadata (e.g. the VALIDATION_PARAMETER endorsement
+        override) — reference: statedb VersionedValue.Metadata."""
+        got = self._metadata.get((ns, key))
+        return dict(got) if got else None
 
     def get_state_range(self, ns: str, start: str,
                         end: str) -> List[Tuple[str, bytes, Version]]:
@@ -92,15 +106,26 @@ class VersionedDB:
             if value is None:
                 if exists:
                     del self._data[(ns, key)]
+                    self._metadata.pop((ns, key), None)
                     keys.pop(bisect.bisect_left(keys, key))
             else:
                 self._data[(ns, key)] = (value, version)
                 if not exists:
                     bisect.insort(keys, key)
+        for (ns, key), (entries, version) in batch.meta_updates.items():
+            got = self._data.get((ns, key))
+            if got is None:
+                continue        # metadata without a key is a no-op
+            # metadata writes bump the key version (MVCC visibility)
+            self._data[(ns, key)] = (got[0], version)
+            if entries:
+                self._metadata[(ns, key)] = dict(entries)
+            else:
+                self._metadata.pop((ns, key), None)
         self._savepoint = block_num
 
     # -- durability ------------------------------------------------------
-    MAGIC = b"FMTSDB1\n"
+    MAGIC = b"FMTSDB2\n"
 
     def snapshot(self, path: str) -> None:
         """Atomic whole-DB snapshot (write-temp + rename)."""
@@ -113,6 +138,16 @@ class VersionedDB:
                 buf.write(struct.pack("<I", len(part)))
                 buf.write(part)
             buf.write(struct.pack("<QQ", bn, tn))
+        buf.write(struct.pack("<I", len(self._metadata)))
+        for (ns, key), entries in sorted(self._metadata.items()):
+            for part in (ns.encode(), key.encode()):
+                buf.write(struct.pack("<I", len(part)))
+                buf.write(part)
+            buf.write(struct.pack("<I", len(entries)))
+            for name, val in sorted(entries.items()):
+                for part in (name.encode(), val):
+                    buf.write(struct.pack("<I", len(part)))
+                    buf.write(part)
         payload = buf.getvalue()
         payload += hashlib.sha256(payload).digest()
         tmp = path + ".tmp"
@@ -150,5 +185,28 @@ class VersionedDB:
             pos += 16
             ns, key = parts[0].decode(), parts[1].decode()
             db._data[(ns, key)] = (parts[2], (bn, tn))
-            bisect.insort(db._keys.setdefault(ns, []), key)
+            db._keys.setdefault(ns, []).append(key)
+        for keys in db._keys.values():     # bulk-sort, not insort^2
+            keys.sort()
+        (mcount,) = struct.unpack_from("<I", body, pos)
+        pos += 4
+        for _ in range(mcount):
+            parts = []
+            for _ in range(2):
+                (ln,) = struct.unpack_from("<I", body, pos)
+                pos += 4
+                parts.append(body[pos:pos + ln])
+                pos += ln
+            (n_entries,) = struct.unpack_from("<I", body, pos)
+            pos += 4
+            entries = {}
+            for _ in range(n_entries):
+                pair = []
+                for _ in range(2):
+                    (ln,) = struct.unpack_from("<I", body, pos)
+                    pos += 4
+                    pair.append(body[pos:pos + ln])
+                    pos += ln
+                entries[pair[0].decode()] = pair[1]
+            db._metadata[(parts[0].decode(), parts[1].decode())] = entries
         return db

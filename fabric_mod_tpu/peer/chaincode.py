@@ -1,0 +1,108 @@
+"""In-process chaincode runtime: contracts, stub, registry.
+
+(reference: core/chaincode/chaincode_support.go:193 `Execute` and the
+shim message protocol handler.go:180-202 HandleGetState/HandlePutState
+— here the container+gRPC stream machinery collapses to a direct call:
+a contract is a Python object invoked against a stub bound to a
+TxSimulator.  The registry is the launch cache; external processes can
+ride behind the same seam later, exactly like the reference's external
+builders.)
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Protocol
+
+
+class ChaincodeError(Exception):
+    pass
+
+
+class ChaincodeStub:
+    """What a contract sees (reference: the shim's stub API surface —
+    GetState/PutState/DelState/GetStateByRange over the tx simulator,
+    which records the read-write set)."""
+
+    def __init__(self, namespace: str, simulator, args: List[bytes],
+                 txid: str, channel_id: str):
+        self.namespace = namespace
+        self._sim = simulator
+        self.args = args
+        self.txid = txid
+        self.channel_id = channel_id
+
+    def get_state(self, key: str) -> Optional[bytes]:
+        return self._sim.get_state(self.namespace, key)
+
+    def put_state(self, key: str, value: bytes) -> None:
+        self._sim.set_state(self.namespace, key, value)
+
+    def del_state(self, key: str) -> None:
+        self._sim.delete_state(self.namespace, key)
+
+    def get_state_range(self, start: str, end: str):
+        return self._sim.get_state_range(self.namespace, start, end)
+
+    def set_state_metadata(self, key: str, name: str, value: bytes) -> None:
+        """(reference: shim PutStateMetadata — e.g. key-level
+        endorsement via the VALIDATION_PARAMETER entry)"""
+        self._sim.set_state_metadata(self.namespace, key, name, value)
+
+
+class Contract(Protocol):
+    def invoke(self, stub: ChaincodeStub) -> bytes: ...
+
+
+class ChaincodeRegistry:
+    """name -> contract (reference: the launch registry + system
+    chaincode table, core/scc/scc.go)."""
+
+    def __init__(self):
+        self._contracts: Dict[str, Contract] = {}
+
+    def register(self, name: str, contract: Contract) -> None:
+        self._contracts[name] = contract
+
+    def get(self, name: str) -> Optional[Contract]:
+        return self._contracts.get(name)
+
+    def execute(self, name: str, stub: ChaincodeStub) -> bytes:
+        cc = self._contracts.get(name)
+        if cc is None:
+            raise ChaincodeError(f"chaincode {name!r} not installed")
+        return cc.invoke(stub)
+
+
+class FuncContract:
+    """Adapter: plain function(stub) -> bytes as a contract."""
+
+    def __init__(self, fn: Callable[[ChaincodeStub], bytes]):
+        self._fn = fn
+
+    def invoke(self, stub: ChaincodeStub) -> bytes:
+        return self._fn(stub)
+
+
+class KvContract:
+    """The classic example contract: args [op, key, value?] with
+    put/get/del — enough to drive the e2e pipeline and tests."""
+
+    def invoke(self, stub: ChaincodeStub) -> bytes:
+        if not stub.args:
+            raise ChaincodeError("no args")
+        op = stub.args[0].decode()
+        if op == "put":
+            stub.put_state(stub.args[1].decode(), stub.args[2])
+            return b"ok"
+        if op == "get":
+            val = stub.get_state(stub.args[1].decode())
+            return val if val is not None else b""
+        if op == "del":
+            stub.del_state(stub.args[1].decode())
+            return b"ok"
+        if op == "setvp":
+            # key-level endorsement override (state-based endorsement,
+            # reference: integration/sbe suites)
+            stub.set_state_metadata(stub.args[1].decode(),
+                                    "VALIDATION_PARAMETER", stub.args[2])
+            return b"ok"
+        raise ChaincodeError(f"unknown op {op!r}")

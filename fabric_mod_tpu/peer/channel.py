@@ -1,0 +1,140 @@
+"""Per-channel peer wiring: bundle + validator + committer + deliver.
+
+(reference: core/peer/peer.go:248 `createChannel` — the function that
+assembles validator, committer, gossip state and config callbacks for
+one channel — plus the channelconfig bundle-swap pattern of
+common/channelconfig/bundlesource.go:103.)
+
+The Channel owns the mutable piece (the current Bundle) and rebuilds
+the per-bundle objects (policy evaluator, validator) atomically when a
+CONFIG tx commits.  Everything downstream reads through `bundle()` /
+`validator()` accessors so a block always validates under exactly one
+config snapshot.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from fabric_mod_tpu.channelconfig import (
+    Bundle, ConfigTxError, extract_config_update, propose_config_update)
+from fabric_mod_tpu.channelconfig.configtx import config_from_block
+from fabric_mod_tpu.peer.mcs import MessageCryptoService
+from fabric_mod_tpu.peer.txvalidator import (
+    Committer, TxValidator, ValidationInfoProvider)
+from fabric_mod_tpu.policy import ApplicationPolicyEvaluator
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+
+# Default endorsement policy reference when the namespace has none
+# (reference: lifecycle's default /Channel/Application/Endorsement)
+DEFAULT_ENDORSEMENT_REF = "/Channel/Application/Endorsement"
+
+
+class Channel:
+    """One channel on one peer (reference: core/peer/peer.go Channel)."""
+
+    def __init__(self, channel_id: str, ledger, verifier, bundle: Bundle,
+                 csp, vinfo: Optional[ValidationInfoProvider] = None):
+        self.channel_id = channel_id
+        self.ledger = ledger
+        self.verifier = verifier
+        self._verifier = verifier
+        self._csp = csp
+        self._lock = threading.Lock()
+        if vinfo is None:
+            # lifecycle-backed: committed chaincode definitions resolve
+            # each namespace's endorsement policy (peer/lifecycle.py)
+            from fabric_mod_tpu.peer.lifecycle import LifecycleValidationInfo
+
+            def state_get(ns: str, key: str):
+                got = self.ledger.state.get_state(ns, key)
+                return got[0] if got else None
+            vinfo = LifecycleValidationInfo(
+                state_get,
+                m.ApplicationPolicy(
+                    channel_config_policy_reference=DEFAULT_ENDORSEMENT_REF
+                ).encode())
+        self._vinfo = vinfo
+        self.mcs = MessageCryptoService(self.bundle, verifier)
+        self._install_bundle(bundle)
+
+    # -- bundle lifecycle -------------------------------------------------
+    def _install_bundle(self, bundle: Bundle) -> None:
+        policy_eval = ApplicationPolicyEvaluator(
+            bundle.msp_manager, bundle.policy_manager)
+        def state_vp(ns: str, key: str):
+            meta = self.ledger.state.get_metadata(ns, key)
+            if meta:
+                from fabric_mod_tpu.peer.txvalidator import (
+                    VALIDATION_PARAMETER)
+                return meta.get(VALIDATION_PARAMETER)
+            return None
+
+        validator = TxValidator(
+            self.channel_id, bundle.msp_manager, policy_eval,
+            self._verifier, self._vinfo,
+            tx_id_exists=self.ledger.tx_id_exists,
+            config_apply=self._validate_and_apply_config,
+            state_metadata=state_vp)
+        with self._lock:
+            self._bundle = bundle
+            self._validator = validator
+
+    def bundle(self) -> Bundle:
+        with self._lock:
+            return self._bundle
+
+    def validator(self) -> TxValidator:
+        with self._lock:
+            return self._validator
+
+    # -- config tx path ---------------------------------------------------
+    def _validate_and_apply_config(self, env: m.Envelope) -> None:
+        """Re-validate an ordered CONFIG envelope against the current
+        bundle and adopt it (reference: validator.go:400-421 +
+        configtx validator Validate).  Called from inside block
+        validation; raising marks the tx INVALID_CONFIG_TRANSACTION."""
+        payload = protoutil.unmarshal_envelope_payload(env)
+        cenv = m.ConfigEnvelope.decode(payload.data)
+        if cenv.config is None:
+            raise ConfigTxError("config envelope carries no config")
+        bundle = self.bundle()
+        if cenv.last_update is None:
+            raise ConfigTxError("config envelope carries no last_update")
+        cue = extract_config_update(cenv.last_update)
+        verify_many = (self._verifier.verify_many
+                       if self._verifier is not None else None)
+        computed = propose_config_update(bundle, cue, verify_many)
+        if computed.encode() != cenv.config.encode():
+            raise ConfigTxError(
+                "ordered config does not match the one computed from "
+                "last_update under the current bundle")
+        self._install_bundle(Bundle(self.channel_id, computed, self._csp))
+
+    def init_from_genesis(self, genesis_block: m.Block) -> List[int]:
+        """Commit block 0 (already validated out-of-band: genesis is
+        the trust anchor, reference: peer channel join)."""
+        flags = [m.TxValidationCode.VALID] * len(genesis_block.data.data)
+        protoutil.set_block_txflags(genesis_block, bytes(flags))
+        return self.ledger.commit_block(genesis_block, flags)
+
+    # -- commit path ------------------------------------------------------
+    def store_block(self, block: m.Block) -> List[int]:
+        """validate -> MVCC -> commit (the reference's coordinator
+        StoreBlock composition, gossip/state/state.go:817)."""
+        flags = self.validator().validate(block)
+        return self.ledger.commit_block(block, flags)
+
+    def committer(self) -> Committer:
+        return _ChannelCommitter(self)
+
+
+class _ChannelCommitter:
+    """Committer facade bound to the channel's CURRENT validator."""
+
+    def __init__(self, channel: Channel):
+        self._channel = channel
+
+    def store_block(self, block: m.Block) -> List[int]:
+        return self._channel.store_block(block)

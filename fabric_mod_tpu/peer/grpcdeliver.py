@@ -1,0 +1,70 @@
+"""gRPC deliver source + broadcast client for the peer side.
+
+(reference: internal/pkg/peer/blocksprovider — the deliver stream
+client with retry/failover — and the broadcast client the CLI uses.)
+
+`GrpcDeliverSource` has the same `blocks()` generator shape as the
+in-process DeliverService, so DeliverClient (and its MCS verification
++ pipelined commit) is transport-agnostic.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional, Sequence
+
+from fabric_mod_tpu.comm.grpc_comm import GRPCClient
+from fabric_mod_tpu.orderer.server import SERVICE, make_seek_envelope
+from fabric_mod_tpu.protos import messages as m
+
+
+class GrpcDeliverSource:
+    def __init__(self, client: GRPCClient, channel_id: str):
+        self._client = client
+        self._channel_id = channel_id
+
+    def blocks(self, start: int = 0, stop: Optional[int] = None,
+               stop_event: Optional[threading.Event] = None,
+               timeout_s: float = 30.0) -> Iterator[m.Block]:
+        import grpc
+        seek = make_seek_envelope(self._channel_id, start, stop)
+        stream = self._client.stream_stream(
+            SERVICE, "Deliver", iter([seek.encode()]))
+        try:
+            for raw in stream:
+                if stop_event is not None and stop_event.is_set():
+                    break
+                resp = m.DeliverResponse.decode(raw)
+                if resp.block is not None:
+                    yield resp.block
+                else:
+                    return                 # terminal status
+        except grpc.RpcError:
+            return                         # disconnect: caller retries
+        finally:
+            stream.cancel()
+
+
+class GrpcBroadcaster:
+    """Streaming broadcast client: submit() enqueues an envelope and
+    returns the orderer's ack status (reference: the broadcast client
+    of internal/pkg + peer CLI)."""
+
+    def __init__(self, client: GRPCClient):
+        self._client = client
+        self._q: "queue.Queue[Optional[bytes]]" = queue.Queue()
+        self._resps = self._client.stream_stream(
+            SERVICE, "Broadcast", iter(self._q.get, None))
+        self._lock = threading.Lock()
+
+    def submit(self, env: m.Envelope) -> None:
+        with self._lock:
+            self._q.put(env.encode())
+            raw = next(self._resps)
+        resp = m.BroadcastResponse.decode(raw)
+        if resp.status != m.Status.SUCCESS:
+            raise RuntimeError(
+                f"broadcast rejected: {resp.status} {resp.info}")
+
+    def close(self) -> None:
+        self._q.put(None)

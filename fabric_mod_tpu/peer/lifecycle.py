@@ -1,0 +1,91 @@
+"""Chaincode lifecycle: committed definitions drive validation info.
+
+(reference: core/chaincode/lifecycle — the `_lifecycle` system
+chaincode (scc.go:911) whose committed definitions the plugin
+dispatcher resolves per namespace (plugindispatcher/dispatcher.go:102,
+deployedcc_infoprovider.go ValidationInfo).  The approve/commit
+two-step collapses to one `commit` op here; the org-approval policy
+gate is the channel's LifecycleEndorsement policy enforced by the
+normal endorsement path, exactly like the reference.)
+
+A definition lives in the `_lifecycle` state namespace under
+`namespaces/<cc>`; because it arrives via an ordinary endorsed tx, it
+is governed, ordered, MVCC-checked, and visible to validation for all
+SUBSEQUENT blocks — the lifecycle cache of the reference without the
+cache (state reads are cheap here).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+from fabric_mod_tpu.peer.chaincode import ChaincodeError, ChaincodeStub
+from fabric_mod_tpu.protos import messages as m
+
+LIFECYCLE_NS = "_lifecycle"
+
+
+def definition_key(cc_name: str) -> str:
+    return f"namespaces/{cc_name}"
+
+
+class LifecycleContract:
+    """The `_lifecycle` system chaincode: args
+    [op, name, ...]; ops: commit(name, version, sequence,
+    endorsement_policy_bytes), query(name)."""
+
+    def invoke(self, stub: ChaincodeStub) -> bytes:
+        if not stub.args:
+            raise ChaincodeError("no args")
+        op = stub.args[0].decode()
+        if op == "commit":
+            name = stub.args[1].decode()
+            version = stub.args[2].decode()
+            sequence = int(stub.args[3].decode())
+            policy = stub.args[4] if len(stub.args) > 4 else b""
+            prev = stub.get_state(definition_key(name))
+            prev_seq = (m.ChaincodeDefinition.decode(prev).sequence
+                        if prev else 0)
+            if sequence != prev_seq + 1:
+                raise ChaincodeError(
+                    f"definition sequence {sequence} != expected "
+                    f"{prev_seq + 1}")
+            d = m.ChaincodeDefinition(
+                sequence=sequence, version=version,
+                endorsement_policy=policy, validation_plugin="vscc")
+            stub.put_state(definition_key(name), d.encode())
+            return b"ok"
+        if op == "query":
+            raw = stub.get_state(definition_key(stub.args[1].decode()))
+            return raw if raw is not None else b""
+        raise ChaincodeError(f"unknown lifecycle op {op!r}")
+
+
+class LifecycleValidationInfo:
+    """Namespace -> (plugin, policy) from committed definitions
+    (reference: plugindispatcher dispatcher.go:102 + the lifecycle
+    ValidatorCommitter).  Falls back to the channel default policy for
+    undefined namespaces — and for `_lifecycle` itself, which is
+    governed by /Channel/Application/LifecycleEndorsement."""
+
+    def __init__(self, state_get: Callable[[str, str], Optional[bytes]],
+                 default_policy: bytes,
+                 lifecycle_policy: Optional[bytes] = None):
+        self._state_get = state_get
+        self._default = default_policy
+        self._lifecycle_policy = lifecycle_policy or m.ApplicationPolicy(
+            channel_config_policy_reference=
+            "/Channel/Application/LifecycleEndorsement").encode()
+
+    def validation_info(self, ns: str) -> Tuple[str, bytes]:
+        if ns == LIFECYCLE_NS:
+            return "vscc", self._lifecycle_policy
+        raw = self._state_get(LIFECYCLE_NS, definition_key(ns))
+        if raw:
+            try:
+                d = m.ChaincodeDefinition.decode(raw)
+                if d.endorsement_policy:
+                    return (d.validation_plugin or "vscc",
+                            d.endorsement_policy)
+            except Exception:
+                pass                        # fall through to default
+        return "vscc", self._default

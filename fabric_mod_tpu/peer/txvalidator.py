@@ -57,17 +57,50 @@ class ValidationInfoProvider:
         self._per_ns[ns] = policy_bytes
 
 
+VALIDATION_PARAMETER = "VALIDATION_PARAMETER"
+
+
+class _KeyEval:
+    """One written key's endorsement-policy resolution candidates.
+
+    (reference: statebased/validator_keylevel.go:243-271 — a key with a
+    VALIDATION_PARAMETER metadata override validates against it; which
+    override is in force can depend on EARLIER txs in the same block,
+    so every candidate's signature checks are staged in pass 1 and the
+    choice is resolved sequentially in pass 3.)
+    """
+
+    __slots__ = ("ns", "key", "committed", "inblock")
+
+    def __init__(self, ns: str, key: str, committed, inblock):
+        self.ns = ns
+        self.key = key
+        self.committed = committed        # PendingEval | None
+        self.inblock = inblock            # [(tx_idx, PendingEval)]
+
+
+class _ActionEval:
+    __slots__ = ("cc_pending", "key_evals")
+
+    def __init__(self, cc_pending, key_evals):
+        self.cc_pending = cc_pending      # chaincode-wide policy
+        self.key_evals = key_evals        # [_KeyEval]
+
+
 class _TxWork:
     """Per-tx staging between the host pass and the device verdict."""
 
-    __slots__ = ("flag", "txid", "creator_slot", "pendings", "is_config")
+    __slots__ = ("flag", "txid", "creator_slot", "actions", "is_config",
+                 "env", "vp_writes")
 
     def __init__(self):
         self.flag = V.NOT_VALIDATED
         self.txid = ""
         self.creator_slot = None          # (batch_idx | None, host_ok)
-        self.pendings = []                # endorsement PendingEvals
+        self.actions = []                 # [_ActionEval]
         self.is_config = False
+        self.env = None                   # kept only for config txs
+        self.vp_writes = []               # [(ns, key, policy_bytes)]
 
 
 class TxValidator:
@@ -77,17 +110,29 @@ class TxValidator:
                  policy_eval: ApplicationPolicyEvaluator,
                  verifier,
                  vinfo: ValidationInfoProvider,
-                 tx_id_exists: Optional[Callable[[str], bool]] = None):
+                 tx_id_exists: Optional[Callable[[str], bool]] = None,
+                 config_apply: Optional[Callable[[m.Envelope], None]] = None,
+                 state_metadata: Optional[Callable[[str, str],
+                                                   Optional[bytes]]] = None):
         self.channel_id = channel_id
         self._msp_mgr = msp_mgr
         self._policy_eval = policy_eval
         self._verifier = verifier
         self._vinfo = vinfo
         self._tx_id_exists = tx_id_exists or (lambda _txid: False)
+        # CONFIG txs: validated + applied through the channel config
+        # machinery (reference: txvalidator/v20/validator.go:400-421 —
+        # config txs are governance, not a signature check).  Fail
+        # closed when no applier is wired.
+        self._config_apply = config_apply
+        # Committed VALIDATION_PARAMETER reader for key-level policies
+        # (reference: the key-level validator's policy fetcher over the
+        # state DB) — returns ApplicationPolicy bytes or None.
+        self._state_metadata = state_metadata
 
     # -- pass 1: host unpack + staging -----------------------------------
     def _stage_tx(self, env: m.Envelope, work: _TxWork,
-                  collector: BatchCollector) -> None:
+                  collector: BatchCollector, inblock_vp) -> None:
         """Syntactic validation + creator/endorsement staging for one
         tx.  Sets work.flag on terminal failure, else leaves VALID
         pending the device verdicts.
@@ -127,6 +172,7 @@ class TxValidator:
 
         if ch.type == m.HeaderType.CONFIG:
             work.is_config = True
+            work.env = env                # finish_tx re-validates+applies
             return                        # config txs skip endorsement
         if ch.type != m.HeaderType.ENDORSER_TRANSACTION:
             work.flag = V.UNKNOWN_TX_TYPE
@@ -162,11 +208,56 @@ class TxValidator:
                                   identity=e.endorser,
                                   signature=e.signature)
                        for e in endorsements]
-                work.pendings.append(
-                    self._policy_eval.prepare(policy_bytes, sds, collector))
+                cc_pending = self._policy_eval.prepare(
+                    policy_bytes, sds, collector)
+                key_evals = self._stage_key_policies(
+                    cca, sds, collector, inblock_vp, work)
+                work.actions.append(_ActionEval(cc_pending, key_evals))
         except Exception:
             work.flag = V.INVALID_ENDORSER_TRANSACTION
             return
+
+    def _stage_key_policies(self, cca, sds, collector, inblock_vp, work):
+        """Stage every candidate key-level endorsement policy of this
+        action's written keys (reference: validator_keylevel.go — the
+        committed VALIDATION_PARAMETER plus any same-block overrides
+        whose applicability pass 3 resolves in order)."""
+        key_evals = []
+        try:
+            rwset = m.TxReadWriteSet.decode(cca.results)
+        except Exception:
+            return key_evals
+        from fabric_mod_tpu.ledger.rwsetutil import parse_tx_rwset
+        for ns, kv in parse_tx_rwset(rwset):
+            written = dict.fromkeys(
+                [w.key for w in kv.writes]
+                + [mw.key for mw in kv.metadata_writes])
+            for key in written:
+                committed_pending = None
+                if self._state_metadata is not None:
+                    vp = self._state_metadata(ns, key)
+                    if vp:
+                        committed_pending = self._policy_eval.prepare(
+                            vp, sds, collector)
+                cands = inblock_vp.get((ns, key), ())
+                inblock = [(idx, self._policy_eval.prepare(vp, sds,
+                                                           collector))
+                           for idx, vp in cands]
+                # EVERY written key gets an eval entry: keys without an
+                # effective VP resolve to None in pass 3 and force the
+                # cc-wide policy — otherwise a tx satisfying one key's
+                # narrow VP could smuggle writes to other keys past the
+                # chaincode policy (fail-closed, like the reference's
+                # per-key fallback to the default policy)
+                key_evals.append(
+                    _KeyEval(ns, key, committed_pending, inblock))
+            # register this tx's own VALIDATION_PARAMETER writes for
+            # later txs in the block (applied only if this tx is VALID)
+            for mw in kv.metadata_writes:
+                for e in mw.entries:
+                    if e.name == VALIDATION_PARAMETER:
+                        work.vp_writes.append((ns, mw.key, e.value))
+        return key_evals
 
     # -- the three passes -------------------------------------------------
     def validate(self, block: m.Block) -> List[int]:
@@ -175,7 +266,11 @@ class TxValidator:
         the flags (reference: validator.go:182-267)."""
         works: List[_TxWork] = []
         collector = BatchCollector()
-        for data in block.data.data:
+        # (ns, key) -> [(tx_idx, ApplicationPolicy bytes)]: the
+        # VALIDATION_PARAMETER writes of EARLIER txs in this block —
+        # the intra-block dependency structure of validator_keylevel.go
+        inblock_vp: Dict[tuple, list] = {}
+        for idx, data in enumerate(block.data.data):
             work = _TxWork()
             works.append(work)
             try:
@@ -183,20 +278,34 @@ class TxValidator:
             except Exception:
                 work.flag = V.BAD_PAYLOAD
                 continue
-            self._stage_tx(env, work, collector)
+            self._stage_tx(env, work, collector, inblock_vp)
+            for ns, key, vp in work.vp_writes:
+                inblock_vp.setdefault((ns, key), []).append((idx, vp))
 
         # pass 2: the device batch
         mask = self._verifier.verify_many(collector.items)
 
-        # pass 3: verdicts
+        # pass 3: sequential verdicts — duplicate marking and key-level
+        # override application happen in block order so later txs see
+        # exactly the effects of earlier VALID ones
         flags: List[int] = []
-        for work in works:
-            flags.append(self._finish_tx(work, mask))
-        self._mark_in_block_duplicates(works, flags)
+        seen_txids = set()
+        applied_vp: Dict[tuple, int] = {}   # (ns, key) -> writer tx_idx
+        for idx, work in enumerate(works):
+            flag = self._finish_tx(work, mask, applied_vp)
+            if flag == V.VALID and work.txid:
+                if work.txid in seen_txids:
+                    flag = V.DUPLICATE_TXID
+                else:
+                    seen_txids.add(work.txid)
+            if flag == V.VALID:
+                for ns, key, _vp in work.vp_writes:
+                    applied_vp[(ns, key)] = idx
+            flags.append(flag)
         protoutil.set_block_txflags(block, bytes(flags))
         return flags
 
-    def _finish_tx(self, work: _TxWork, mask) -> int:
+    def _finish_tx(self, work: _TxWork, mask, applied_vp) -> int:
         if work.flag != V.NOT_VALIDATED:
             return work.flag
         bidx, host_ok = work.creator_slot
@@ -204,25 +313,36 @@ class TxValidator:
         if not creator_ok:
             return V.BAD_CREATOR_SIGNATURE
         if work.is_config:
+            # (reference: validator.go:400-421 — the config envelope is
+            # re-validated against the current bundle's mod policies and
+            # applied; anything short of that is INVALID, fail-closed)
+            if self._config_apply is None:
+                return V.INVALID_CONFIG_TRANSACTION
+            try:
+                self._config_apply(work.env)
+            except Exception:
+                return V.INVALID_CONFIG_TRANSACTION
             return V.VALID
-        for pending in work.pendings:
-            if not pending.finish(mask):
+        for action in work.actions:
+            uncovered = not action.key_evals
+            for ke in action.key_evals:
+                writer = applied_vp.get((ke.ns, ke.key))
+                pending = None
+                if writer is not None:
+                    for tx_idx, cand in ke.inblock:
+                        if tx_idx == writer:
+                            pending = cand
+                            break
+                if pending is None:
+                    pending = ke.committed
+                if pending is None:
+                    uncovered = True        # falls to the cc-wide policy
+                    continue
+                if not pending.finish(mask):
+                    return V.ENDORSEMENT_POLICY_FAILURE
+            if uncovered and not action.cc_pending.finish(mask):
                 return V.ENDORSEMENT_POLICY_FAILURE
         return V.VALID
-
-    @staticmethod
-    def _mark_in_block_duplicates(works: Sequence[_TxWork],
-                                  flags: List[int]) -> None:
-        """First occurrence of a tx id wins
-        (reference: validator.go:281 markTXIdDuplicates)."""
-        seen = set()
-        for i, work in enumerate(works):
-            if flags[i] != V.VALID or not work.txid:
-                continue
-            if work.txid in seen:
-                flags[i] = V.DUPLICATE_TXID
-            else:
-                seen.add(work.txid)
 
 
 class Committer:

@@ -1,0 +1,167 @@
+"""Key-level (state-based) endorsement + lifecycle validation info.
+
+(reference test model: integration/sbe state-based-endorsement suites
+and core/common/validation/statebased/validator_keylevel tests: a
+key's VALIDATION_PARAMETER overrides the chaincode-wide policy, with
+intra-block ordering of override writes.)
+"""
+import threading
+import time
+
+import pytest
+
+from fabric_mod_tpu.e2e import Network
+from fabric_mod_tpu.policy import from_string
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+
+V = m.TxValidationCode
+
+
+@pytest.fixture()
+def net(tmp_path):
+    n = Network(str(tmp_path), batch_timeout="100ms",
+                max_message_count=25)
+    yield n
+    n.close()
+
+
+def _commit_all(net, n_envs, timeout=20.0):
+    client = net.deliver_client()
+    t = threading.Thread(target=client.run, daemon=True)
+    t.start()
+    deadline = time.time() + timeout
+    committed = 0
+    while time.time() < deadline:
+        committed = sum(
+            len(net.ledger.get_block_by_number(i).data.data)
+            for i in range(1, net.ledger.height))
+        if committed >= n_envs:
+            break
+        time.sleep(0.02)
+    client.stop()
+    t.join(timeout=5)
+    return committed
+
+
+def _all_flags(net):
+    out = []
+    for i in range(1, net.ledger.height):
+        blk = net.ledger.get_block_by_number(i)
+        out.extend(protoutil.block_txflags(blk))
+    return out
+
+
+def _org_policy(*orgs) -> bytes:
+    dsl = "OR(%s)" % ", ".join(f"'{o}.peer'" for o in orgs)
+    return m.ApplicationPolicy(signature_policy=from_string(dsl)).encode()
+
+
+def test_key_level_policy_flips_between_blocks(net):
+    # block A: create the key + pin it to Org3 only
+    net.invoke([b"put", b"pinned", b"v0"])
+    committed = _commit_all(net, 1)
+    assert committed == 1
+    net.invoke([b"setvp", b"pinned", _org_policy("Org3")],
+               endorsing_orgs=["Org1", "Org2"])
+    assert _commit_all(net, 2) == 2
+
+    # block B: writing with 2-of-3 (Org1+Org2) violates the Org3 pin
+    net.invoke([b"put", b"pinned", b"v1"],
+               endorsing_orgs=["Org1", "Org2"])
+    # while an Org3-endorsed write passes
+    net.invoke([b"put", b"pinned", b"v2"], endorsing_orgs=["Org3"])
+    assert _commit_all(net, 4) == 4
+
+    flags = _all_flags(net)
+    assert flags.count(V.ENDORSEMENT_POLICY_FAILURE) == 1
+    assert flags.count(V.VALID) == 3
+    qe = net.ledger.new_query_executor()
+    assert qe.get_state("mycc", "pinned") == b"v2"
+    # the metadata survived in the state DB
+    meta = net.ledger.state.get_metadata("mycc", "pinned")
+    assert meta and "VALIDATION_PARAMETER" in meta
+
+
+def test_key_level_intra_block_dependency(net):
+    """An override committed in tx i of a block governs tx j > i of
+    the SAME block (reference: validator_keylevel's dep tracking)."""
+    net.invoke([b"put", b"k", b"v0"])
+    assert _commit_all(net, 1) == 1
+
+    # same block: [setvp -> Org3 only, write endorsed by Org1+Org2]
+    net.invoke([b"setvp", b"k", _org_policy("Org3")],
+               endorsing_orgs=["Org1", "Org2"])
+    net.invoke([b"put", b"k", b"v1"], endorsing_orgs=["Org1", "Org2"])
+    assert _commit_all(net, 3) == 3
+
+    flags = _all_flags(net)
+    # the setvp is VALID; the 2-of-3 write in the same block already
+    # validates under the new Org3-only pin -> fails
+    assert flags.count(V.ENDORSEMENT_POLICY_FAILURE) == 1
+    qe = net.ledger.new_query_executor()
+    assert qe.get_state("mycc", "k") == b"v0"
+
+
+def test_vp_on_one_key_does_not_bypass_cc_policy_on_others(net):
+    """A tx satisfying key A's narrow VP must still satisfy the
+    chaincode-wide policy for its OTHER written keys (regression: the
+    cc-wide check must not be skipped when any key has a VP)."""
+    net.invoke([b"put", b"a", b"0"])
+    assert _commit_all(net, 1) == 1
+    # pin key "a" to Org3 only
+    net.invoke([b"setvp", b"a", _org_policy("Org3")],
+               endorsing_orgs=["Org1", "Org2"])
+    assert _commit_all(net, 2) == 2
+
+    # Org3 alone satisfies a's VP but NOT the cc-wide MAJORITY(2-of-3);
+    # the tx also writes key "b" (no VP) -> must fail
+    from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder
+    from fabric_mod_tpu.protos import protoutil as pu
+    b = RWSetBuilder()
+    b.add_write("mycc", "a", b"x")       # VP-covered (Org3)
+    b.add_write("mycc", "b", b"y")       # cc-wide policy applies
+    env = pu.create_signed_tx(
+        net.channel_id, "mycc", b.build().encode(), net.client,
+        [net.peer_signers["Org3"]])      # satisfies a's VP only
+    blk = pu.new_block(
+        net.ledger.height,
+        pu.block_header_hash(net.ledger.get_block_by_number(
+            net.ledger.height - 1).header), [env])
+    flags = net.channel.validator().validate(blk)
+    assert flags == [V.ENDORSEMENT_POLICY_FAILURE]
+
+    # control: Org3 + Org1 (VP satisfied AND 2-of-3 majority) passes
+    env2 = pu.create_signed_tx(
+        net.channel_id, "mycc", b.build().encode(), net.client,
+        [net.peer_signers["Org3"], net.peer_signers["Org1"]])
+    blk2 = pu.new_block(
+        net.ledger.height,
+        pu.block_header_hash(net.ledger.get_block_by_number(
+            net.ledger.height - 1).header), [env2])
+    flags2 = net.channel.validator().validate(blk2)
+    assert flags2 == [V.VALID]
+
+
+def test_lifecycle_definition_changes_cc_policy(net):
+    """Committing a chaincode definition flips the namespace's
+    endorsement policy for subsequent blocks (reference:
+    plugindispatcher resolving lifecycle ValidationInfo)."""
+    # default channel policy: MAJORITY Endorsement (2 of 3) — passes
+    net.invoke([b"put", b"a", b"1"], endorsing_orgs=["Org1", "Org2"])
+    assert _commit_all(net, 1) == 1
+
+    # commit a definition pinning mycc to Org1 only
+    net.invoke([b"commit", b"mycc", b"2.0", b"1", _org_policy("Org1")],
+               endorsing_orgs=["Org1", "Org2"], chaincode="_lifecycle")
+    assert _commit_all(net, 2) == 2
+
+    # now Org2-endorsed writes fail, Org1-endorsed pass
+    net.invoke([b"put", b"b", b"2"], endorsing_orgs=["Org2"])
+    net.invoke([b"put", b"c", b"3"], endorsing_orgs=["Org1"])
+    assert _commit_all(net, 4) == 4
+    flags = _all_flags(net)
+    assert flags.count(V.ENDORSEMENT_POLICY_FAILURE) == 1
+    qe = net.ledger.new_query_executor()
+    assert qe.get_state("mycc", "c") == b"3"
+    assert qe.get_state("mycc", "b") is None

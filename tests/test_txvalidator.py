@@ -208,7 +208,11 @@ def test_nil_and_garbage_envelopes(world):
     assert flags[1] == V.BAD_PAYLOAD
 
 
-def test_config_tx_skips_endorsement(world):
+def test_config_tx_requires_config_machinery(world):
+    """CONFIG txs skip endorsement but are fail-closed: without a
+    wired config applier they are INVALID_CONFIG_TRANSACTION, and an
+    applier's verdict decides (reference: validator.go:400-421 — a
+    creator signature alone never commits governance)."""
     o = world["orgs"]
     ch = protoutil.make_channel_header(m.HeaderType.CONFIG, CHANNEL,
                                        tx_id="cfg")
@@ -217,7 +221,21 @@ def test_config_tx_skips_endorsement(world):
     payload = protoutil.make_payload(ch, sh, b"config-envelope")
     env = protoutil.sign_envelope(payload, o["Org1"]["client"])
     validator, _ = _validator(world)
+    assert validator.validate(_block([env])) == \
+        [V.INVALID_CONFIG_TRANSACTION]
+
+    # with an applier: its acceptance makes the tx VALID...
+    seen = []
+    validator._config_apply = seen.append
     assert validator.validate(_block([env])) == [V.VALID]
+    assert len(seen) == 1
+    # ...and its rejection marks the tx invalid
+
+    def reject(_env):
+        raise ValueError("mod policy says no")
+    validator._config_apply = reject
+    assert validator.validate(_block([env])) == \
+        [V.INVALID_CONFIG_TRANSACTION]
 
 
 def test_committer_pipeline_with_mvcc(world, tmp_path):
