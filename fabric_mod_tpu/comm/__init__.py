@@ -1,0 +1,5 @@
+"""L4 communication: gRPC over mutual TLS (reference:
+internal/pkg/comm) + TLS material utilities (common/crypto)."""
+from fabric_mod_tpu.comm.grpc_comm import (   # noqa: F401
+    GRPCClient, GRPCServer, MethodKind)
+from fabric_mod_tpu.comm.tls import TlsCA, track_expiration  # noqa: F401

@@ -1,0 +1,132 @@
+"""gRPC server/client wrappers over mutual TLS.
+
+(reference: internal/pkg/comm — GRPCServer at server.go:268 with
+client-cert verification, GRPCClient at client.go:211, keepalive and
+message-size options in config.go.)
+
+The framework's wire messages are the deterministic hand-rolled codec
+(protos/wire.py), so services register **generic byte handlers**
+(identity serializers) instead of protoc stubs — the method path
+carries the service contract, the payload is our encoding.  This is
+the L4 control plane; device batches never cross these sockets
+(SURVEY §5.8: gRPC for control, XLA for data).
+"""
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Callable, Dict, Optional, Tuple
+
+import grpc
+
+_IDENT = (lambda b: b, lambda b: b)
+
+_OPTIONS = [
+    ("grpc.max_send_message_length", 100 * 1024 * 1024),
+    ("grpc.max_receive_message_length", 100 * 1024 * 1024),
+    ("grpc.keepalive_time_ms", 60_000),
+    ("grpc.keepalive_timeout_ms", 20_000),
+]
+
+
+class MethodKind:
+    UNARY = "unary"
+    SERVER_STREAM = "server_stream"
+    STREAM_STREAM = "stream_stream"
+
+
+class GRPCServer:
+    """mTLS gRPC server with generic byte-level method registration."""
+
+    def __init__(self, address: str = "127.0.0.1:0",
+                 server_cert_pem: Optional[bytes] = None,
+                 server_key_pem: Optional[bytes] = None,
+                 client_root_pem: Optional[bytes] = None,
+                 max_workers: int = 16):
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=_OPTIONS)
+        self._services: Dict[str, Dict[str, Tuple[str, Callable]]] = {}
+        if server_cert_pem is not None:
+            creds = grpc.ssl_server_credentials(
+                [(server_key_pem, server_cert_pem)],
+                root_certificates=client_root_pem,
+                require_client_auth=client_root_pem is not None)
+            self.port = self._server.add_secure_port(address, creds)
+        else:
+            self.port = self._server.add_insecure_port(address)
+        if self.port == 0:
+            raise RuntimeError(f"could not bind {address}")
+
+    def register(self, service: str, method: str, kind: str,
+                 handler: Callable) -> None:
+        self._services.setdefault(service, {})[method] = (kind, handler)
+
+    def start(self) -> None:
+        for service, methods in self._services.items():
+            rpcs = {}
+            for name, (kind, fn) in methods.items():
+                if kind == MethodKind.UNARY:
+                    rpcs[name] = grpc.unary_unary_rpc_method_handler(
+                        fn, *_IDENT)
+                elif kind == MethodKind.SERVER_STREAM:
+                    rpcs[name] = grpc.unary_stream_rpc_method_handler(
+                        fn, *_IDENT)
+                else:
+                    rpcs[name] = grpc.stream_stream_rpc_method_handler(
+                        fn, *_IDENT)
+            self._server.add_generic_rpc_handlers(
+                (grpc.method_handlers_generic_handler(service, rpcs),))
+        self._server.start()
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace)
+
+
+class GRPCClient:
+    """mTLS channel factory + method helpers."""
+
+    def __init__(self, target: str,
+                 server_root_pem: Optional[bytes] = None,
+                 client_cert_pem: Optional[bytes] = None,
+                 client_key_pem: Optional[bytes] = None,
+                 override_authority: Optional[str] = None):
+        opts = list(_OPTIONS)
+        if override_authority:
+            opts.append(("grpc.ssl_target_name_override",
+                         override_authority))
+        if server_root_pem is not None:
+            creds = grpc.ssl_channel_credentials(
+                root_certificates=server_root_pem,
+                private_key=client_key_pem,
+                certificate_chain=client_cert_pem)
+            self._channel = grpc.secure_channel(target, creds,
+                                                options=opts)
+        else:
+            self._channel = grpc.insecure_channel(target, options=opts)
+
+    def unary(self, service: str, method: str, request: bytes,
+              timeout: Optional[float] = 30.0) -> bytes:
+        fn = self._channel.unary_unary(
+            f"/{service}/{method}",
+            request_serializer=_IDENT[0],
+            response_deserializer=_IDENT[1])
+        return fn(request, timeout=timeout)
+
+    def server_stream(self, service: str, method: str, request: bytes,
+                      timeout: Optional[float] = None):
+        fn = self._channel.unary_stream(
+            f"/{service}/{method}",
+            request_serializer=_IDENT[0],
+            response_deserializer=_IDENT[1])
+        return fn(request, timeout=timeout)
+
+    def stream_stream(self, service: str, method: str, requests,
+                      timeout: Optional[float] = None):
+        fn = self._channel.stream_stream(
+            f"/{service}/{method}",
+            request_serializer=_IDENT[0],
+            response_deserializer=_IDENT[1])
+        return fn(requests, timeout=timeout)
+
+    def close(self) -> None:
+        self._channel.close()

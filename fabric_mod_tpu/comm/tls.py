@@ -1,0 +1,105 @@
+"""TLS material generation + certificate expiration tracking.
+
+(reference: common/crypto — tlsgen's on-the-fly TLS CAs for tests and
+TrackExpiration's warn-before-expiry scanning at
+common/crypto/expiration.go.)
+
+Reuses the MSP CA library for issuance; TLS certs get
+serverAuth/clientAuth EKUs and SAN entries, which the MSP CA's
+identity certs don't carry.
+"""
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+
+from fabric_mod_tpu.msp import ca as calib
+
+
+class TlsCA:
+    """A TLS-only CA (reference: common/crypto/tlsgen/ca.go)."""
+
+    def __init__(self, name: str = "tlsca", org: str = "tls"):
+        self._ca = calib.CA(name, org)
+
+    @property
+    def cert_pem(self) -> bytes:
+        return self._ca.cert_pem()
+
+    def issue(self, cn: str, sans: Sequence[str] = ("localhost",),
+              server: bool = True, client: bool = True,
+              valid_days: int = 365) -> Tuple[bytes, bytes]:
+        """-> (cert PEM, key PEM) with proper EKUs + SANs."""
+        key = ec.generate_private_key(ec.SECP256R1())
+        now = datetime.datetime.now(datetime.timezone.utc)
+        san_entries: List[x509.GeneralName] = []
+        for s in sans:
+            try:
+                san_entries.append(
+                    x509.IPAddress(ipaddress.ip_address(s)))
+            except ValueError:
+                san_entries.append(x509.DNSName(s))
+        ekus = []
+        if server:
+            ekus.append(x509.oid.ExtendedKeyUsageOID.SERVER_AUTH)
+        if client:
+            ekus.append(x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH)
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(x509.Name([x509.NameAttribute(
+                x509.oid.NameOID.COMMON_NAME, cn)]))
+            .issuer_name(self._ca.cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=valid_days))
+            .add_extension(x509.BasicConstraints(ca=False,
+                                                 path_length=None),
+                           critical=True)
+            .add_extension(x509.SubjectAlternativeName(san_entries),
+                           critical=False)
+            .add_extension(x509.ExtendedKeyUsage(ekus), critical=False)
+            .sign(self._ca.key, hashes.SHA256()))
+        return (cert.public_bytes(serialization.Encoding.PEM),
+                calib.key_pem(key))
+
+
+def write_pems(dir_path: str, **pems: bytes) -> dict:
+    """Write named PEMs to files; returns {name: path} (gRPC creds
+    APIs want in-memory bytes, but ssl contexts want files)."""
+    os.makedirs(dir_path, exist_ok=True)
+    out = {}
+    for name, data in pems.items():
+        path = os.path.join(dir_path, f"{name}.pem")
+        with open(path, "wb") as f:
+            f.write(data)
+        out[name] = path
+    return out
+
+
+def track_expiration(cert_pems: Sequence[bytes],
+                     warn: Callable[[str], None],
+                     now: Optional[datetime.datetime] = None,
+                     warn_within_days: int = 7) -> List[str]:
+    """Warn for certs expiring soon/already (reference:
+    common/crypto/expiration.go TrackExpiration).  Returns the warned
+    subjects."""
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    flagged = []
+    for pem in cert_pems:
+        cert = x509.load_pem_x509_certificate(pem)
+        subject = cert.subject.rfc4514_string()
+        left = cert.not_valid_after_utc - now
+        if left.total_seconds() <= 0:
+            warn(f"certificate {subject} has expired")
+            flagged.append(subject)
+        elif left <= datetime.timedelta(days=warn_within_days):
+            warn(f"certificate {subject} expires in {left}")
+            flagged.append(subject)
+    return flagged

@@ -1,0 +1,262 @@
+"""The gossip node: push dissemination + pull anti-entropy + routing.
+
+(reference: gossip/gossip/gossip_impl.go — handleMessage routing,
+sqrt-N push fan-out, the message store dedup, and algo/pull.go's
+hello/digest/request/update engine.)
+
+One node per (peer, channel).  Blocks are MCS-verified (orderer
+signature policy over the batch verifier) BEFORE entering the state
+buffer — the same gate the deliver client applies
+(internal/peer/gossip/mcs.go:124).
+"""
+from __future__ import annotations
+
+import math
+import random
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+from fabric_mod_tpu.gossip.comm import GossipComm, InProcNetwork
+from fabric_mod_tpu.gossip.discovery import Discovery
+from fabric_mod_tpu.gossip.identity import IdentityMapper, pki_id_of
+from fabric_mod_tpu.gossip.protoext import sign_message, verify_envelope
+from fabric_mod_tpu.gossip.state import GossipStateProvider
+from fabric_mod_tpu.peer.mcs import BlockVerificationError
+from fabric_mod_tpu.protos import messages as m
+
+
+class GossipNode:
+    def __init__(self, endpoint: str, signer, channel,
+                 network: InProcNetwork, fanout: Optional[int] = None,
+                 rng: Optional[random.Random] = None):
+        self.endpoint = endpoint
+        self._signer = signer
+        self._channel = channel          # peer.Channel (MCS + commit)
+        self._network = network
+        self._fanout = fanout
+        self._rng = rng or random.Random()
+        self._identity = signer.serialize()
+        self.pki_id = pki_id_of(self._identity)
+        self.mapper = IdentityMapper(channel.bundle().msp_manager,
+                                     channel.verifier)
+        self.mapper.put(self._identity)
+        self.comm = GossipComm(endpoint, self.pki_id, network,
+                               signer)
+        self._members_by_pki: Dict[bytes, str] = {}
+        self.discovery = Discovery(
+            m.GossipMember(endpoint=endpoint, pki_id=self.pki_id),
+            self._identity, self.comm)
+        self.state = GossipStateProvider(
+            channel, request_missing=self._pull_range)
+        self._seen_lock = threading.Lock()
+        # bounded message store: FIFO eviction stands in for the
+        # reference's TTL'd store (gossip msgstore) — unbounded growth
+        # is a leak at sustained gossip rates
+        self._seen_nonces: set = set()
+        self._seen_order: "deque[int]" = deque()
+        self.seen_cap = 100_000
+        network.register(endpoint, self.on_message)
+
+    # -- outbound ---------------------------------------------------------
+    def _pick_peers(self, k: Optional[int] = None) -> List[str]:
+        peers = [p for p in self.discovery.alive_endpoints()
+                 if p != self.endpoint]
+        if not peers:
+            return []
+        if k is None:
+            # sqrt-N fan-out with the reference's small-net floor
+            # (gossip defaults PropagatePeerNum=3)
+            k = self._fanout or max(2, int(math.isqrt(len(peers))))
+        self._rng.shuffle(peers)
+        return peers[:k]
+
+    def gossip_block(self, block: m.Block) -> None:
+        """Push a block to ~sqrt(N) peers (reference: the emit/fan-out
+        path of gossip_impl.go)."""
+        nonce = self._rng.getrandbits(63)
+        msg = m.GossipMessage(
+            nonce=nonce, channel=self._channel.channel_id.encode(),
+            data_msg=m.DataMessage(payload=m.GossipPayload(
+                seq_num=block.header.number, data=block.encode())))
+        self._remember_nonce(nonce)
+        self.comm.broadcast(self._pick_peers(), msg)
+
+    def _remember_nonce(self, nonce: int) -> bool:
+        """Record a nonce; False when already seen.  Bounded FIFO."""
+        with self._seen_lock:
+            if nonce in self._seen_nonces:
+                return False
+            self._seen_nonces.add(nonce)
+            self._seen_order.append(nonce)
+            while len(self._seen_order) > self.seen_cap:
+                self._seen_nonces.discard(self._seen_order.popleft())
+            return True
+
+    def join(self, bootstrap_endpoints: List[str]) -> None:
+        """Announce ourselves to bootstrap peers."""
+        msg = self.discovery.make_alive()
+        self.comm.broadcast(
+            [e for e in bootstrap_endpoints if e != self.endpoint], msg)
+
+    # -- inbound routing (reference: gossip_impl.go handleMessage) -------
+    def on_message(self, src_pki_id: bytes, env_bytes: bytes) -> None:
+        try:
+            env = m.GossipEnvelope.decode(env_bytes)
+        except Exception:
+            return
+        msg = verify_envelope(
+            env, lambda payload, sig:
+            self.mapper.verify(src_pki_id, payload, sig)
+            or self._verify_with_carried_identity(env, payload, sig))
+        if msg is None:
+            return
+        if msg.alive_msg is not None:
+            self._handle_alive(src_pki_id, msg.alive_msg)
+        elif msg.data_msg is not None:
+            self._handle_data(msg)
+        elif msg.hello is not None:
+            self._handle_hello(src_pki_id, msg)
+        elif msg.data_dig is not None:
+            self._handle_digest(src_pki_id, msg)
+        elif msg.data_req is not None:
+            self._handle_request(src_pki_id, msg)
+        elif msg.data_update is not None:
+            self._handle_update(msg)
+
+    def _verify_with_carried_identity(self, env, payload, sig) -> bool:
+        """Bootstrap: an alive message carries its own identity —
+        admit it if the MSP validates it and the signature checks
+        (reference: the identity learning on first contact)."""
+        try:
+            msg = m.GossipMessage.decode(env.payload)
+        except Exception:
+            return False
+        if msg.alive_msg is None or not msg.alive_msg.identity:
+            return False
+        try:
+            pid = self.mapper.put(msg.alive_msg.identity)
+        except Exception:
+            return False
+        return self.mapper.verify(pid, payload, sig)
+
+    def _handle_alive(self, src: bytes, alive: m.AliveMessage) -> None:
+        pid = (pki_id_of(alive.identity) if alive.identity
+               else (alive.membership.pki_id if alive.membership else b""))
+        if not pid or pid == self.pki_id:
+            return
+        if alive.membership is not None:
+            self._members_by_pki[pid] = alive.membership.endpoint
+        if self.discovery.handle_alive(pid, alive):
+            # fresh news travels (push membership epidemically)
+            fwd = m.GossipMessage(alive_msg=alive)
+            self.comm.broadcast(
+                [e for e in self._pick_peers()
+                 if e != (alive.membership.endpoint
+                          if alive.membership else "")], fwd)
+
+    def _handle_data(self, msg: m.GossipMessage) -> None:
+        if not self._remember_nonce(msg.nonce):
+            return                          # dedup (message store)
+        payload = msg.data_msg.payload
+        if payload is None:
+            return
+        try:
+            block = m.Block.decode(payload.data)
+            self._channel.mcs.verify_block(
+                self._channel.channel_id, block)
+        except (BlockVerificationError, Exception):
+            return                          # unverifiable: drop, no relay
+        if self.state.add_block(block):
+            # forward fresh blocks (push epidemic)
+            self.comm.broadcast(self._pick_peers(), msg)
+
+    # -- pull engine (reference: algo/pull.go) ----------------------------
+    def pull_tick(self) -> None:
+        """Send a hello to one random peer asking what blocks it has."""
+        peers = self._pick_peers(1)
+        if not peers:
+            return
+        nonce = self._rng.getrandbits(63)
+        self.comm.send(peers[0], m.GossipMessage(
+            nonce=nonce, hello=m.GossipHello(nonce=nonce)))
+
+    def _pull_range(self, gap: range) -> None:
+        peers = self._pick_peers(1)
+        if not peers:
+            return
+        digests = [str(n).encode() for n in gap]
+        self.comm.send(peers[0], m.GossipMessage(
+            data_req=m.DataRequest(nonce=self._rng.getrandbits(63),
+                                   digests=digests)))
+
+    def _handle_hello(self, src: bytes, msg: m.GossipMessage) -> None:
+        src_ep = self._members_by_pki.get(src)
+        if src_ep is None:
+            return
+        height = self._channel.ledger.height
+        digests = [str(n).encode() for n in range(height)]
+        self.comm.send(src_ep, m.GossipMessage(
+            data_dig=m.DataDigest(nonce=msg.hello.nonce,
+                                  digests=digests)))
+
+    def _handle_digest(self, src: bytes, msg: m.GossipMessage) -> None:
+        src_ep = self._members_by_pki.get(src)
+        if src_ep is None:
+            return
+        have = self._channel.ledger.height
+        wanted = []
+        for d in msg.data_dig.digests:      # peer-supplied: parse safely
+            try:
+                if int(d.decode()) >= have:
+                    wanted.append(d)
+            except (ValueError, UnicodeDecodeError):
+                continue
+        if not wanted:
+            return
+        self.comm.send(src_ep, m.GossipMessage(
+            data_req=m.DataRequest(nonce=msg.data_dig.nonce,
+                                   digests=wanted)))
+
+    def _handle_request(self, src: bytes, msg: m.GossipMessage) -> None:
+        src_ep = self._members_by_pki.get(src)
+        if src_ep is None:
+            return
+        out = []
+        for d in msg.data_req.digests:
+            try:
+                num = int(d.decode())
+            except ValueError:
+                continue
+            block = self._channel.ledger.get_block_by_number(num)
+            if block is None:
+                continue
+            inner = m.GossipMessage(
+                nonce=self._rng.getrandbits(63),
+                data_msg=m.DataMessage(payload=m.GossipPayload(
+                    seq_num=num, data=block.encode())))
+            out.append(sign_message(inner, self._signer))
+        if out:
+            self.comm.send(src_ep, m.GossipMessage(
+                data_update=m.DataUpdate(nonce=msg.data_req.nonce,
+                                         data=out)))
+
+    def _handle_update(self, msg: m.GossipMessage) -> None:
+        for env in msg.data_update.data:
+            inner = verify_envelope(
+                env, lambda payload, sig: True)  # block sigs checked next
+            if inner is None or inner.data_msg is None:
+                continue
+            payload = inner.data_msg.payload
+            try:
+                block = m.Block.decode(payload.data)
+                self._channel.mcs.verify_block(
+                    self._channel.channel_id, block)
+            except Exception:
+                continue
+            self.state.add_block(block)
+
+    def stop(self) -> None:
+        self._network.unregister(self.endpoint)
+        self.discovery.stop()
+        self.state.stop()

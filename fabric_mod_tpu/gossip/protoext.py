@@ -1,0 +1,32 @@
+"""Signed gossip message helpers.
+
+(reference: gossip/protoext/signing.go:209 — every gossip message
+travels as an envelope whose payload is signed by the sender and
+verified against the sender's identity via the MCS.)
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from fabric_mod_tpu.protos import messages as m
+
+
+def sign_message(msg: m.GossipMessage, signer) -> m.GossipEnvelope:
+    payload = msg.encode()
+    return m.GossipEnvelope(payload=payload,
+                            signature=signer.sign_message(payload))
+
+
+def verify_envelope(env: m.GossipEnvelope,
+                    verify: Callable[[bytes, bytes], bool]
+                    ) -> Optional[m.GossipMessage]:
+    """-> decoded message if `verify(payload, signature)` holds, else
+    None (fail-closed)."""
+    if not env.payload or not env.signature:
+        return None
+    if not verify(env.payload, env.signature):
+        return None
+    try:
+        return m.GossipMessage.decode(env.payload)
+    except Exception:
+        return None
