@@ -1,0 +1,5 @@
+"""Idemix anonymous credentials over FP256BN pairings (reference:
+idemix/ + bccsp/idemix).  Host-side reference implementation this
+round; kernel decomposition in KERNEL_PLAN.md."""
+from fabric_mod_tpu.idemix.credential import (   # noqa: F401
+    Credential, IssuerKey, credential_valid, issue, sign, verify)
