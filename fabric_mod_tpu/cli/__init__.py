@@ -1,0 +1,1 @@
+"""CLI tools (reference: cmd/ + internal/{cryptogen,configtxgen,peer})."""

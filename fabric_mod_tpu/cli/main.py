@@ -1,0 +1,31 @@
+"""The umbrella CLI: fabric-mod-tpu <tool> ...
+
+(reference: the cmd/{peer,orderer,configtxgen,cryptogen} binaries and
+internal/peer's cobra tree, collapsed to subcommands of one entry.)
+"""
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print("usage: fabric-mod-tpu {cryptogen|configtxgen|node} ...",
+              file=sys.stderr)
+        return 2
+    tool, rest = argv[0], argv[1:]
+    if tool == "cryptogen":
+        from fabric_mod_tpu.cli.cryptogen import main as run
+    elif tool == "configtxgen":
+        from fabric_mod_tpu.cli.configtxgen import main as run
+    elif tool == "node":
+        from fabric_mod_tpu.cli.node import main as run
+    else:
+        print(f"unknown tool {tool!r}", file=sys.stderr)
+        return 2
+    return run(rest)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

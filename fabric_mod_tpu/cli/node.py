@@ -1,0 +1,125 @@
+"""node: run an orderer + committing peer in one process.
+
+(reference: internal/peer/node/start.go:205 `serve` + orderer/common/
+server/main.go:71 `Main` — the bring-up wiring: config, crypto,
+registrar, channels, ops server — shrunk to the in-process topology
+until the gRPC comm layer lands.)
+
+    fabric-mod-tpu node --genesis genesis.block --crypto crypto-config \
+        --orderer-org OrdererOrg --peer-config core.yaml
+
+Starts the solo ordering service + a peer committing via the deliver
+client, exposes /metrics /healthz /logspec on the ops address, and
+runs until interrupted.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+from fabric_mod_tpu.bccsp.sw import SwCSP
+from fabric_mod_tpu.channelconfig import Bundle
+from fabric_mod_tpu.channelconfig.configtx import config_from_block
+from fabric_mod_tpu.config import PeerConfig, load_config
+from fabric_mod_tpu.ledger.kvledger import LedgerManager
+from fabric_mod_tpu.msp import ca as calib
+from fabric_mod_tpu.msp.identities import SigningIdentity
+from fabric_mod_tpu.observability import (
+    HealthRegistry, OperationsServer, default_provider, get_logger,
+    init_logging)
+from fabric_mod_tpu.orderer import Broadcast, DeliverService, Registrar
+from fabric_mod_tpu.peer.channel import Channel
+from fabric_mod_tpu.peer.deliverclient import DeliverClient
+from fabric_mod_tpu.protos import messages as m
+
+log = get_logger("node")
+
+
+def _load_signer(crypto_dir: str, org: str, kind: str, csp):
+    from cryptography import x509
+    base = os.path.join(crypto_dir, org)
+    cert_path = os.path.join(base, f"{kind}s", f"{kind}0.pem")
+    key_path = os.path.join(base, f"{kind}s", f"{kind}0.key")
+    with open(cert_path, "rb") as f:
+        cert = x509.load_pem_x509_certificate(f.read())
+    with open(key_path, "rb") as f:
+        key_pem = f.read()
+    return SigningIdentity(org, cert, key_pem, csp)
+
+
+def run_node(genesis_path: str, crypto_dir: str, orderer_org: str,
+             data_dir: str, peer_cfg: PeerConfig,
+             stop_event=None) -> None:
+    init_logging(default_provider(), peer_cfg.log_spec)
+    csp = SwCSP()
+    with open(genesis_path, "rb") as f:
+        genesis_block = m.Block.decode(f.read())
+    cid, config = config_from_block(genesis_block)
+
+    orderer_signer = _load_signer(crypto_dir, orderer_org, "orderer", csp)
+    registrar = Registrar(os.path.join(data_dir, "orderer"),
+                          orderer_signer, csp)
+    if registrar.get_chain(cid) is None:
+        support = registrar.create_channel(genesis_block)
+    else:
+        support = registrar.get_chain(cid)
+    broadcast = Broadcast(registrar)
+
+    if peer_cfg.bccsp.upper() == "TPU":
+        from fabric_mod_tpu.bccsp.tpu import TpuVerifier
+        verifier = TpuVerifier()
+    else:
+        from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
+        verifier = FakeBatchVerifier(csp)
+
+    ledger_mgr = LedgerManager(os.path.join(data_dir, peer_cfg.ledger_dir))
+    ledger = ledger_mgr.create_or_open(cid)
+    bundle = Bundle(cid, config, csp)
+    channel = Channel(cid, ledger, verifier, bundle, csp)
+    if ledger.height == 0:
+        channel.init_from_genesis(genesis_block)
+
+    health = HealthRegistry()
+    health.register("ledger", lambda: None if ledger.height > 0 else
+                    (_ for _ in ()).throw(RuntimeError("empty ledger")))
+    host, _, port = peer_cfg.ops_listen_address.partition(":")
+    ops = OperationsServer(host or "127.0.0.1", int(port or 0),
+                           default_provider(), health)
+    ops.start()
+    log.info("ops server on %s; channel %s at height %d",
+             ops.addr, cid, ledger.height)
+
+    client = DeliverClient(channel, DeliverService(support),
+                           queue_size=peer_cfg.deliver_queue_size)
+    runner = threading.Thread(
+        target=lambda: client.run(idle_timeout_s=3600.0), daemon=True)
+    runner.start()
+
+    stop = stop_event or threading.Event()
+    try:
+        signal.signal(signal.SIGINT, lambda *_: stop.set())
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    except ValueError:
+        pass                               # not the main thread (tests)
+    stop.wait()
+    client.stop()
+    ops.stop()
+    registrar.close()
+    ledger_mgr.close()
+    return broadcast
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(prog="node")
+    ap.add_argument("--genesis", required=True)
+    ap.add_argument("--crypto", default="crypto-config")
+    ap.add_argument("--orderer-org", default="OrdererOrg")
+    ap.add_argument("--data", default="data")
+    ap.add_argument("--config", default=None, help="core.yaml path")
+    args = ap.parse_args(argv)
+    peer_cfg = load_config(PeerConfig, args.config)
+    run_node(args.genesis, args.crypto, args.orderer_org, args.data,
+             peer_cfg)
+    return 0

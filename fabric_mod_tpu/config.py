@@ -1,0 +1,99 @@
+"""Node configuration: YAML files + environment overrides.
+
+(reference: the viper config system — core/peer/config.go reading
+core.yaml with CORE_* env overrides, orderer/common/localconfig/
+config.go:505 reading orderer.yaml with ORDERER_* — collapsed to one
+typed loader.)
+
+Lookup order (highest wins): environment variable
+`<PREFIX>_SECTION_SUBKEY`, the YAML file, the dataclass default —
+the same precedence viper gives the reference.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+import yaml
+
+
+def _env_override(prefix: str, path: str) -> Optional[str]:
+    """peer.ledger.snapshotEvery -> PREFIX_LEDGER_SNAPSHOTEVERY."""
+    key = prefix + "_" + "_".join(
+        p.upper() for p in path.split(".")[1:])
+    return os.environ.get(key)
+
+
+def _dig(data: Dict[str, Any], path: str) -> Optional[Any]:
+    cur: Any = data
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        # tolerate case differences like viper
+        lowered = {k.lower(): v for k, v in cur.items()}
+        cur = lowered.get(part.lower())
+    return cur
+
+
+@dataclasses.dataclass
+class PeerConfig:
+    """(reference: core/peer/config.go Config — the subset in play)"""
+    ledger_dir: str = "data/ledgers"
+    validator_pool_size: int = 0        # 0 = device-batched (no pool)
+    ops_listen_address: str = "127.0.0.1:0"
+    log_spec: str = "info"
+    deliver_queue_size: int = 8
+    bccsp: str = "TPU"                  # TPU | SW
+
+    FIELDS = {
+        "ledger_dir": "peer.fileSystemPath",
+        "validator_pool_size": "peer.validatorPoolSize",
+        "ops_listen_address": "operations.listenAddress",
+        "log_spec": "logging.spec",
+        "deliver_queue_size": "peer.deliverclient.queueSize",
+        "bccsp": "peer.BCCSP.Default",
+    }
+    ENV_PREFIX = "CORE"
+
+
+@dataclasses.dataclass
+class OrdererConfig:
+    """(reference: orderer/common/localconfig/config.go)"""
+    ledger_dir: str = "data/orderer"
+    consensus_type: str = "solo"
+    ops_listen_address: str = "127.0.0.1:0"
+    log_spec: str = "info"
+
+    FIELDS = {
+        "ledger_dir": "general.fileSystemPath",
+        "consensus_type": "general.consensusType",
+        "ops_listen_address": "operations.listenAddress",
+        "log_spec": "logging.spec",
+    }
+    ENV_PREFIX = "ORDERER"
+
+
+def load_config(cls, path: Optional[str] = None):
+    """Materialize a typed config: defaults <- YAML <- env."""
+    data: Dict[str, Any] = {}
+    if path and os.path.exists(path):
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+    out = cls()
+    for attr, yaml_path in cls.FIELDS.items():
+        val = _dig(data, yaml_path)
+        env = _env_override(cls.ENV_PREFIX, yaml_path)
+        if env is not None:
+            val = env
+        if val is None:
+            continue
+        default = getattr(out, attr)
+        if isinstance(default, bool):
+            val = str(val).lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            val = int(val)
+        else:
+            val = str(val)
+        setattr(out, attr, val)
+    return out

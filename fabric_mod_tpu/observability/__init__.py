@@ -1,0 +1,9 @@
+"""Observability: metrics, health, logging, ops HTTP server
+(reference: common/metrics, common/flogging, core/operations)."""
+from fabric_mod_tpu.observability.metrics import (      # noqa: F401
+    Counter, Gauge, Histogram, MetricOpts, MetricsProvider,
+    default_provider)
+from fabric_mod_tpu.observability.logging import (      # noqa: F401
+    activate_spec, get_logger, init_logging)
+from fabric_mod_tpu.observability.opsserver import (    # noqa: F401
+    HealthRegistry, OperationsServer)

@@ -1,0 +1,111 @@
+"""Operations HTTP server: /metrics /healthz /logspec /version.
+
+(reference: core/operations/system.go:60-270 — the ops listener every
+node runs: prometheus scrape endpoint, health checker registry,
+dynamic log levels, build info.)
+
+stdlib http.server on a daemon thread; handlers read the same
+in-process registries the node components write.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from fabric_mod_tpu.observability import logging as flog
+from fabric_mod_tpu.observability.metrics import (
+    MetricsProvider, default_provider)
+
+VERSION = "0.3.0"
+
+
+class HealthRegistry:
+    """(reference: the healthz checker registry, system.go:141)"""
+
+    def __init__(self):
+        self._checkers: Dict[str, Callable[[], None]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, checker: Callable[[], None]) -> None:
+        with self._lock:
+            self._checkers[name] = checker
+
+    def status(self):
+        failures = {}
+        with self._lock:
+            checkers = dict(self._checkers)
+        for name, check in checkers.items():
+            try:
+                check()
+            except Exception as e:
+                failures[name] = str(e)
+        return ("OK" if not failures else "Service Unavailable", failures)
+
+
+class OperationsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 provider: Optional[MetricsProvider] = None,
+                 health: Optional[HealthRegistry] = None):
+        self.provider = provider or default_provider()
+        self.health = health or HealthRegistry()
+        ops = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):     # quiet
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "text/plain") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._send(200,
+                               ops.provider.render_prometheus().encode())
+                elif self.path == "/healthz":
+                    status, failures = ops.health.status()
+                    code = 200 if status == "OK" else 503
+                    self._send(code, json.dumps(
+                        {"status": status,
+                         "failed_checks": failures}).encode(),
+                        "application/json")
+                elif self.path == "/logspec":
+                    self._send(200, json.dumps(
+                        {"spec": flog.current_spec()}).encode(),
+                        "application/json")
+                elif self.path == "/version":
+                    self._send(200, json.dumps(
+                        {"Version": VERSION}).encode(),
+                        "application/json")
+                else:
+                    self._send(404, b"not found")
+
+            def do_PUT(self):
+                if self.path == "/logspec":
+                    ln = int(self.headers.get("Content-Length", "0"))
+                    try:
+                        body = json.loads(self.rfile.read(ln) or b"{}")
+                        flog.activate_spec(body.get("spec", "info"))
+                        self._send(204, b"")
+                    except Exception as e:
+                        self._send(400, str(e).encode())
+                else:
+                    self._send(404, b"not found")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.addr = self._httpd.server_address
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,73 @@
+"""CLI + config system tests: cryptogen -> configtxgen -> loadable
+genesis; YAML/env config precedence.
+
+(reference test model: internal/cryptogen + configtxgen round-trip
+usage in integration/nwo's network generation.)
+"""
+import os
+
+from fabric_mod_tpu.bccsp.sw import SwCSP
+from fabric_mod_tpu.channelconfig import Bundle
+from fabric_mod_tpu.channelconfig.configtx import config_from_block
+from fabric_mod_tpu.cli.configtxgen import main as configtxgen_main
+from fabric_mod_tpu.cli.cryptogen import main as cryptogen_main
+from fabric_mod_tpu.config import PeerConfig, load_config
+from fabric_mod_tpu.protos import messages as m
+
+
+def test_cryptogen_configtxgen_roundtrip(tmp_path):
+    crypto_conf = tmp_path / "crypto.yaml"
+    crypto_conf.write_text(
+        "PeerOrgs:\n"
+        "  - Name: Org1\n    PeerCount: 2\n    UserCount: 1\n"
+        "  - Name: Org2\n    PeerCount: 1\n"
+        "OrdererOrgs:\n"
+        "  - Name: OrdererOrg\n    OrdererCount: 1\n")
+    out = str(tmp_path / "crypto")
+    assert cryptogen_main(["--config", str(crypto_conf),
+                           "--output", out]) == 0
+    assert os.path.exists(f"{out}/Org1/ca/ca.pem")
+    assert os.path.exists(f"{out}/Org1/peers/peer1.pem")
+    assert os.path.exists(f"{out}/Org1/users/user0.key")
+    assert os.path.exists(f"{out}/Org1/admin/admin.pem")
+    assert os.path.exists(f"{out}/OrdererOrg/orderers/orderer0.pem")
+
+    profile = tmp_path / "configtx.yaml"
+    profile.write_text(
+        "ChannelID: mychan\n"
+        "PeerOrgs: [Org1, Org2]\n"
+        "OrdererOrgs: [OrdererOrg]\n"
+        "BatchSize:\n  MaxMessageCount: 123\n"
+        "BatchTimeout: 750ms\n")
+    gen = str(tmp_path / "genesis.block")
+    assert configtxgen_main(["--profile", str(profile),
+                             "--crypto", out, "--output", gen]) == 0
+
+    with open(gen, "rb") as f:
+        block = m.Block.decode(f.read())
+    cid, config = config_from_block(block)
+    assert cid == "mychan"
+    bundle = Bundle(cid, config, SwCSP())
+    assert bundle.application.org_mspids == ("Org1", "Org2")
+    bc = bundle.batch_config()
+    assert bc.max_message_count == 123
+    assert abs(bc.batch_timeout_s - 0.75) < 1e-9
+
+
+def test_config_yaml_env_precedence(tmp_path, monkeypatch):
+    core = tmp_path / "core.yaml"
+    core.write_text(
+        "peer:\n  fileSystemPath: /from/yaml\n"
+        "  validatorPoolSize: 7\n"
+        "operations:\n  listenAddress: 127.0.0.1:9443\n")
+    cfg = load_config(PeerConfig, str(core))
+    assert cfg.ledger_dir == "/from/yaml"
+    assert cfg.validator_pool_size == 7
+    assert cfg.ops_listen_address == "127.0.0.1:9443"
+    assert cfg.bccsp == "TPU"              # default preserved
+
+    monkeypatch.setenv("CORE_FILESYSTEMPATH", "/from/env")
+    monkeypatch.setenv("CORE_BCCSP_DEFAULT", "SW")
+    cfg = load_config(PeerConfig, str(core))
+    assert cfg.ledger_dir == "/from/env"
+    assert cfg.bccsp == "SW"

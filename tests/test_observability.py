@@ -1,0 +1,88 @@
+"""Metrics provider, logging specs, ops HTTP endpoints.
+
+(reference test model: common/metrics + core/operations/system_test.go
+— scrape the endpoints a node exposes and check the registries.)
+"""
+import json
+import urllib.request
+
+from fabric_mod_tpu.observability import (
+    HealthRegistry, MetricOpts, MetricsProvider, OperationsServer,
+    activate_spec, get_logger, init_logging)
+from fabric_mod_tpu.observability.logging import current_spec
+
+
+def test_counter_gauge_histogram_render():
+    p = MetricsProvider()
+    c = p.new_counter(MetricOpts("peer", "tx", "validated_total",
+                                 "validated txs", ("status",)))
+    c.with_labels("valid").add(3)
+    c.with_labels("invalid").add()
+    g = p.new_gauge(MetricOpts("ledger", "", "height"))
+    g.set(17)
+    h = p.new_histogram(MetricOpts("ledger", "", "commit_seconds"),
+                        buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = p.render_prometheus()
+    assert 'peer_tx_validated_total{status="valid"} 3' in text
+    assert "ledger_height 17" in text
+    assert 'ledger_commit_seconds_bucket{le="0.1"} 1' in text
+    assert 'ledger_commit_seconds_bucket{le="+Inf"} 3' in text
+    assert "ledger_commit_seconds_count 3" in text
+
+
+def test_histogram_timer():
+    p = MetricsProvider()
+    h = p.new_histogram(MetricOpts("x", "", "t"))
+    with h.time():
+        pass
+    assert h.count == 1 and h.sum >= 0
+
+
+def test_logging_spec_roundtrip():
+    init_logging(spec="info")
+    activate_spec("peer=debug:warn")
+    import logging
+    assert logging.getLogger("fabric_mod_tpu").level == logging.WARNING
+    assert logging.getLogger("fabric_mod_tpu.peer").level == logging.DEBUG
+    assert current_spec() == "peer=debug:warn"
+    activate_spec("info")          # restore for other tests
+
+
+def test_ops_server_endpoints():
+    p = MetricsProvider()
+    p.new_gauge(MetricOpts("node", "", "up")).set(1)
+    health = HealthRegistry()
+    health.register("alwaysok", lambda: None)
+    srv = OperationsServer(provider=p, health=health)
+    srv.start()
+    host, port = srv.addr
+    base = f"http://{host}:{port}"
+    try:
+        body = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "node_up 1" in body
+        hz = json.load(urllib.request.urlopen(base + "/healthz"))
+        assert hz["status"] == "OK"
+        ver = json.load(urllib.request.urlopen(base + "/version"))
+        assert "Version" in ver
+        # logspec PUT
+        req = urllib.request.Request(
+            base + "/logspec", data=json.dumps(
+                {"spec": "ledger=debug:info"}).encode(), method="PUT")
+        assert urllib.request.urlopen(req).status == 204
+        spec = json.load(urllib.request.urlopen(base + "/logspec"))
+        assert spec["spec"] == "ledger=debug:info"
+        # failing health check flips status
+        health.register("down", lambda: (_ for _ in ()).throw(
+            RuntimeError("broken")))
+        try:
+            urllib.request.urlopen(base + "/healthz")
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert json.load(e)["failed_checks"]["down"] == "broken"
+    finally:
+        srv.stop()
+        activate_spec("info")
