@@ -1,0 +1,465 @@
+"""Raft consensus for the ordering service.
+
+(reference: orderer/consensus/etcdraft — the etcd/raft library driven
+by chain.go:533's single-threaded FSM loop, WAL+snapshot storage in
+storage.go, and leader-side block proposing at :791/:860.  This is an
+original, compact Raft — same protocol rules, none of etcd's code:
+randomized election timeouts, term/vote persistence, log matching,
+leader commit rules (commit only entries of the current term by
+counting replicas), follower log repair by decrementing next_index.)
+
+Design decisions that mirror the reference's use of raft:
+* The payload replicated through the log is a FULL serialized block
+  (the leader cuts batches; followers never re-cut) — exactly
+  etcdraft's "leader proposes block data" model, which makes apply
+  deterministic across nodes regardless of local timers.
+* Each node signs committed blocks with its own orderer identity;
+  data/prev hashes are identical everywhere, metadata signatures are
+  per-node (any of them satisfies the BlockValidation policy).
+* Transport is a seam (`RaftTransport`): in-process delivery for
+  tests, the gRPC cluster Step stream later — message schema is
+  already wire-shaped dataclasses.
+
+The node runs a single FSM thread (like chain.go:533): one queue
+carries timer ticks, peer messages, and local proposals; all state
+transitions happen on that thread.  Term/vote/log survive restarts
+via a CRC-framed WAL (same framing as ledger/durable.py).
+"""
+from __future__ import annotations
+
+import io
+import os
+import queue
+import random
+import struct
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, Optional, Tuple
+
+# --- messages (wire-shaped; the gRPC cluster Step carries these) -----------
+
+
+class RequestVote:
+    __slots__ = ("term", "candidate", "last_log_index", "last_log_term")
+
+    def __init__(self, term, candidate, last_log_index, last_log_term):
+        self.term = term
+        self.candidate = candidate
+        self.last_log_index = last_log_index
+        self.last_log_term = last_log_term
+
+
+class VoteReply:
+    __slots__ = ("term", "voter", "granted")
+
+    def __init__(self, term, voter, granted):
+        self.term = term
+        self.voter = voter
+        self.granted = granted
+
+
+class AppendEntries:
+    __slots__ = ("term", "leader", "prev_index", "prev_term", "entries",
+                 "leader_commit")
+
+    def __init__(self, term, leader, prev_index, prev_term, entries,
+                 leader_commit):
+        self.term = term
+        self.leader = leader
+        self.prev_index = prev_index
+        self.prev_term = prev_term
+        self.entries = entries          # [(term, bytes)]
+        self.leader_commit = leader_commit
+
+
+class AppendReply:
+    __slots__ = ("term", "follower", "success", "match_index")
+
+    def __init__(self, term, follower, success, match_index):
+        self.term = term
+        self.follower = follower
+        self.success = success
+        self.match_index = match_index
+
+
+class RaftTransport:
+    """node_id -> deliver(msg).  In-process registry (the test fabric);
+    a gRPC Step-stream adapter registers the same surface."""
+
+    def __init__(self):
+        self._handlers: Dict[str, Callable] = {}
+        self._lock = threading.Lock()
+        self.partitioned: set = set()
+
+    def register(self, node_id: str, handler: Callable) -> None:
+        with self._lock:
+            self._handlers[node_id] = handler
+
+    def send(self, src: str, dst: str, msg) -> None:
+        with self._lock:
+            if src in self.partitioned or dst in self.partitioned:
+                return
+            handler = self._handlers.get(dst)
+        if handler is not None:
+            try:
+                handler(src, msg)
+            except Exception:
+                pass
+
+
+# --- WAL -------------------------------------------------------------------
+
+_HARDSTATE, _ENTRY = 0, 1
+
+
+class RaftWAL:
+    """Append-only persistence of (term, voted_for) + log entries
+    (reference: etcd WAL via storage.go:244; same crash contract —
+    torn tails cropped by CRC framing)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.entries: List[Tuple[int, bytes]] = []   # 1-based index
+        self._truncations = 0
+        if os.path.exists(path):
+            self._replay()
+        self._f = open(path, "ab")
+
+    def _replay(self) -> None:
+        raw = open(self._path, "rb").read()
+        pos = 0
+        good_end = 0
+        while pos + 8 <= len(raw):
+            ln, crc = struct.unpack_from("<II", raw, pos)
+            end = pos + 8 + ln
+            if end > len(raw):
+                break
+            payload = raw[pos + 8:end]
+            if zlib.crc32(payload) != crc:
+                break
+            kind = payload[0]
+            if kind == _HARDSTATE:
+                (self.term,) = struct.unpack_from("<q", payload, 1)
+                (vl,) = struct.unpack_from("<I", payload, 9)
+                self.voted_for = (payload[13:13 + vl].decode()
+                                  if vl else None)
+            elif kind == _ENTRY:
+                eterm, upto = struct.unpack_from("<qq", payload, 1)
+                data = payload[17:]
+                # upto = the index this entry lands at; truncate any
+                # conflicting suffix (log repair happened before write)
+                del self.entries[upto - 1:]
+                self.entries.append((eterm, data))
+            good_end = end
+            pos = end
+        if good_end < len(raw):
+            with open(self._path, "r+b") as f:
+                f.truncate(good_end)
+
+    def _frame(self, payload: bytes) -> bytes:
+        return struct.pack("<II", len(payload),
+                           zlib.crc32(payload)) + payload
+
+    def save_hardstate(self, term: int, voted_for: Optional[str]) -> None:
+        self.term = term
+        self.voted_for = voted_for
+        v = (voted_for or "").encode()
+        payload = (bytes([_HARDSTATE]) + struct.pack("<q", term)
+                   + struct.pack("<I", len(v)) + v)
+        self._f.write(self._frame(payload))
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def append(self, index: int, term: int, data: bytes) -> None:
+        """Write entry at 1-based `index`, truncating conflicts."""
+        del self.entries[index - 1:]
+        self.entries.append((term, data))
+        payload = (bytes([_ENTRY]) + struct.pack("<qq", term, index)
+                   + data)
+        self._f.write(self._frame(payload))
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.close()
+
+
+# --- the node --------------------------------------------------------------
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+class RaftNode:
+    """One replica.  `apply_cb(index, data)` fires exactly once per
+    committed entry, in order, on the FSM thread."""
+
+    def __init__(self, node_id: str, peers: List[str],
+                 transport: RaftTransport, wal_path: str,
+                 apply_cb: Callable[[int, bytes], None],
+                 election_timeout: Tuple[float, float] = (0.15, 0.3),
+                 heartbeat_s: float = 0.05,
+                 rng: Optional[random.Random] = None):
+        self.id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self._transport = transport
+        self._wal = RaftWAL(wal_path)
+        self._apply = apply_cb
+        self._eto = election_timeout
+        self._hb = heartbeat_s
+        self._rng = rng or random.Random()
+
+        self.state = FOLLOWER
+        self.leader_id: Optional[str] = None
+        self.commit_index = 0
+        self.last_applied = 0
+        self._votes: set = set()
+        self._next_index: Dict[str, int] = {}
+        self._match_index: Dict[str, int] = {}
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._deadline = 0.0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        transport.register(node_id, lambda src, msg:
+                           self._q.put(("msg", src, msg)))
+
+    # -- public ----------------------------------------------------------
+    def start(self) -> None:
+        self._reset_election_timer()
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._q.put(("noop",))
+        self._thread.join(timeout=5)
+        self._wal.close()
+
+    def propose(self, data: bytes) -> bool:
+        """Leader-only; returns False when not the leader (caller
+        forwards to `leader_id` — reference: chain Submit :494)."""
+        if self.state != LEADER:
+            return False
+        self._q.put(("propose", data))
+        return True
+
+    @property
+    def last_index(self) -> int:
+        return len(self._wal.entries)
+
+    def _last_term(self) -> int:
+        return self._wal.entries[-1][0] if self._wal.entries else 0
+
+    # -- FSM loop (reference: chain.go:533 run) ---------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            timeout = max(0.0, self._deadline - time.monotonic())
+            try:
+                item = self._q.get(timeout=timeout)
+            except queue.Empty:
+                self._on_timer()
+                continue
+            kind = item[0]
+            if kind == "msg":
+                self._on_message(item[1], item[2])
+            elif kind == "propose":
+                self._on_propose(item[1])
+
+    def _reset_election_timer(self) -> None:
+        self._deadline = (time.monotonic()
+                          + self._rng.uniform(*self._eto))
+
+    def _on_timer(self) -> None:
+        if self.state == LEADER:
+            self._broadcast_append()
+            self._deadline = time.monotonic() + self._hb
+        else:
+            self._start_election()
+
+    # -- elections --------------------------------------------------------
+    def _start_election(self) -> None:
+        self.state = CANDIDATE
+        self._wal.save_hardstate(self._wal.term + 1, self.id)
+        self._votes = {self.id}
+        self.leader_id = None
+        self._reset_election_timer()
+        msg = RequestVote(self._wal.term, self.id, self.last_index,
+                          self._last_term())
+        for p in self.peers:
+            self._transport.send(self.id, p, msg)
+        self._maybe_win()
+
+    def _maybe_win(self) -> None:
+        if self.state == CANDIDATE and \
+                len(self._votes) * 2 > len(self.peers) + 1:
+            self.state = LEADER
+            self.leader_id = self.id
+            self._next_index = {p: self.last_index + 1
+                                for p in self.peers}
+            self._match_index = {p: 0 for p in self.peers}
+            # no-op barrier entry: lets the new leader commit prior-term
+            # entries per the current-term counting rule
+            self._append_local(b"")
+            self._advance_commit()         # single-node quorum
+            self._broadcast_append()
+            self._deadline = time.monotonic() + self._hb
+
+    def _step_down(self, term: int) -> None:
+        if term > self._wal.term:
+            self._wal.save_hardstate(term, None)
+        self.state = FOLLOWER
+        self._votes = set()
+        # a deposed leader must not keep advertising itself: consumers
+        # (submit forwarding) would loop messages back to this node
+        if self.leader_id == self.id:
+            self.leader_id = None
+        self._reset_election_timer()
+
+    # -- log machinery ----------------------------------------------------
+    def _append_local(self, data: bytes) -> int:
+        idx = self.last_index + 1
+        self._wal.append(idx, self._wal.term, data)
+        return idx
+
+    def _on_propose(self, data: bytes) -> None:
+        if self.state != LEADER:
+            return
+        self._append_local(data)
+        self._advance_commit()             # single-node quorum
+        self._broadcast_append()
+
+    def _broadcast_append(self) -> None:
+        for p in self.peers:
+            self._send_append(p)
+
+    MAX_ENTRIES_PER_APPEND = 64            # reference: MaxInflightBlocks
+
+    def _send_append(self, peer: str) -> None:
+        nxt = self._next_index.get(peer, self.last_index + 1)
+        prev_index = nxt - 1
+        prev_term = (self._wal.entries[prev_index - 1][0]
+                     if prev_index >= 1 and
+                     prev_index <= len(self._wal.entries) else 0)
+        # cap the suffix: a lagging follower is repaired in bounded
+        # chunks instead of O(K^2) full-suffix resends per heartbeat
+        entries = self._wal.entries[nxt - 1:
+                                    nxt - 1 + self.MAX_ENTRIES_PER_APPEND]
+        self._transport.send(self.id, peer, AppendEntries(
+            self._wal.term, self.id, prev_index, prev_term,
+            list(entries), self.commit_index))
+
+    # -- message handling --------------------------------------------------
+    def _on_message(self, src: str, msg) -> None:
+        if isinstance(msg, RequestVote):
+            self._on_request_vote(msg)
+        elif isinstance(msg, VoteReply):
+            self._on_vote_reply(msg)
+        elif isinstance(msg, AppendEntries):
+            self._on_append(msg)
+        elif isinstance(msg, AppendReply):
+            self._on_append_reply(msg)
+
+    def _on_request_vote(self, msg: RequestVote) -> None:
+        if msg.term > self._wal.term:
+            self._step_down(msg.term)
+        granted = False
+        if msg.term == self._wal.term and \
+                self._wal.voted_for in (None, msg.candidate):
+            # candidate's log must be at least as up-to-date (§5.4.1)
+            up_to_date = (msg.last_log_term, msg.last_log_index) >= \
+                (self._last_term(), self.last_index)
+            if up_to_date:
+                granted = True
+                self._wal.save_hardstate(self._wal.term, msg.candidate)
+                self._reset_election_timer()
+        self._transport.send(self.id, msg.candidate, VoteReply(
+            self._wal.term, self.id, granted))
+
+    def _on_vote_reply(self, msg: VoteReply) -> None:
+        if msg.term > self._wal.term:
+            self._step_down(msg.term)
+            return
+        if self.state == CANDIDATE and msg.term == self._wal.term \
+                and msg.granted:
+            self._votes.add(msg.voter)
+            self._maybe_win()
+
+    def _on_append(self, msg: AppendEntries) -> None:
+        if msg.term > self._wal.term or \
+                (msg.term == self._wal.term and self.state != FOLLOWER):
+            self._step_down(msg.term)
+        if msg.term < self._wal.term:
+            self._transport.send(self.id, msg.leader, AppendReply(
+                self._wal.term, self.id, False, 0))
+            return
+        self.leader_id = msg.leader
+        self._reset_election_timer()
+        # log matching check
+        if msg.prev_index > 0:
+            if msg.prev_index > self.last_index or \
+                    self._wal.entries[msg.prev_index - 1][0] != \
+                    msg.prev_term:
+                self._transport.send(self.id, msg.leader, AppendReply(
+                    self._wal.term, self.id, False, 0))
+                return
+        # append (truncating conflicts)
+        idx = msg.prev_index
+        for eterm, data in msg.entries:
+            idx += 1
+            if idx <= self.last_index:
+                if self._wal.entries[idx - 1][0] == eterm:
+                    continue               # already have it
+            self._wal.append(idx, eterm, data)
+        if msg.leader_commit > self.commit_index:
+            self.commit_index = min(msg.leader_commit, self.last_index)
+            self._apply_committed()
+        self._transport.send(self.id, msg.leader, AppendReply(
+            self._wal.term, self.id, True, idx))
+
+    def _on_append_reply(self, msg: AppendReply) -> None:
+        if msg.term > self._wal.term:
+            self._step_down(msg.term)
+            return
+        if self.state != LEADER or msg.term != self._wal.term:
+            return
+        if msg.success:
+            self._match_index[msg.follower] = max(
+                self._match_index.get(msg.follower, 0), msg.match_index)
+            self._next_index[msg.follower] = \
+                self._match_index[msg.follower] + 1
+            self._advance_commit()
+        else:
+            # repair: back off one step and retry (§5.3)
+            self._next_index[msg.follower] = max(
+                1, self._next_index.get(msg.follower,
+                                        self.last_index + 1) - 1)
+            self._send_append(msg.follower)
+
+    def _advance_commit(self) -> None:
+        """Commit the highest index replicated on a majority whose
+        entry is from the CURRENT term (§5.4.2)."""
+        for n in range(self.last_index, self.commit_index, -1):
+            if self._wal.entries[n - 1][0] != self._wal.term:
+                break
+            count = 1 + sum(1 for p in self.peers
+                            if self._match_index.get(p, 0) >= n)
+            if count * 2 > len(self.peers) + 1:
+                self.commit_index = n
+                self._apply_committed()
+                self._broadcast_append()   # propagate the commit index
+                break
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            nxt = self.last_applied + 1
+            term, data = self._wal.entries[nxt - 1]
+            if data:                       # skip no-op barrier entries
+                try:
+                    self._apply(nxt, data)
+                except Exception:
+                    # do NOT advance past a failed apply: skipping a
+                    # committed entry silently diverges this node's
+                    # chain; stop and retry on the next commit signal
+                    return
+            self.last_applied = nxt

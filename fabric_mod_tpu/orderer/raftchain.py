@@ -1,0 +1,234 @@
+"""The Raft-backed consenter: the consenter contract over RaftNode.
+
+(reference: orderer/consensus/etcdraft/chain.go — Order/Configure at
+:381/:387, Submit-forwarding to the leader at :494, the leader-side
+blockcutter + batch timer inside run at :533, and block writing on
+apply at :791/:964.)
+
+Replicated payload = one CUT BATCH (flag byte + BlockData of envelope
+bytes).  Every node builds the block at APPLY time from its own chain
+tip — heights, prev hashes, and data hashes are identical everywhere
+because apply order is identical; only the per-node metadata
+signature differs.  Config batches carry exactly one envelope and
+swap the bundle through the same ChainSupport.process_config path the
+solo consenter uses.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from fabric_mod_tpu.orderer.consensus import ChainHaltedError
+from fabric_mod_tpu.orderer.raft import RaftNode, RaftTransport
+from fabric_mod_tpu.protos import messages as m
+
+_NORMAL, _CONFIG = 0, 1
+
+
+class _Submit:
+    """Envelope forwarded to the leader (reference: Submit :494)."""
+
+    __slots__ = ("env_bytes", "is_config", "config_seq")
+
+    def __init__(self, env_bytes: bytes, is_config: bool,
+                 config_seq: int):
+        self.env_bytes = env_bytes
+        self.is_config = is_config
+        self.config_seq = config_seq
+
+
+def _encode_batch(envs: List[m.Envelope], kind: int) -> bytes:
+    return bytes([kind]) + m.BlockData(
+        data=[e.encode() for e in envs]).encode()
+
+
+def _decode_batch(data: bytes) -> Tuple[int, List[m.Envelope]]:
+    kind = data[0]
+    bd = m.BlockData.decode(data[1:])
+    return kind, [m.Envelope.decode(d) for d in bd.data]
+
+
+class RaftChain:
+    """Consenter with the SoloChain surface (order/configure/start/
+    halt/wait_ready) plus leader awareness."""
+
+    RAFT_INDEX_MD_SLOT = 3                 # block metadata slot
+
+    def __init__(self, node_id: str, peer_ids: List[str],
+                 transport: RaftTransport, wal_path: str, support,
+                 election_timeout=(0.15, 0.3), heartbeat_s=0.05):
+        self.node_id = node_id
+        self._support = support
+        self._transport = transport
+        self._raft = RaftNode(node_id, peer_ids, transport, wal_path,
+                              self._apply, election_timeout, heartbeat_s)
+        transport.register(f"{node_id}:chain", self._on_chain_msg)
+        self._q: "queue.Queue[Optional[_Submit]]" = queue.Queue(10_000)
+        self._halted = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        # Applied-index recovery: each block records the raft index of
+        # the entry that produced it, so a restart replaying the WAL
+        # skips entries already in the block store (otherwise every
+        # restart would re-append the whole chain at new heights —
+        # reference: etcdraft's lastBlock/appliedIndex in the
+        # consenter metadata).
+        self._applied_upto = 0
+        h = support.store.height
+        if h > 1:
+            tip = support.store.get_block_by_number(h - 1)
+            md = tip.metadata.metadata if tip.metadata else []
+            if len(md) > self.RAFT_INDEX_MD_SLOT and \
+                    md[self.RAFT_INDEX_MD_SLOT]:
+                self._applied_upto = int.from_bytes(
+                    md[self.RAFT_INDEX_MD_SLOT], "big")
+
+    # -- consenter surface ------------------------------------------------
+    def start(self) -> None:
+        self._raft.start()
+        self._thread.start()
+
+    def halt(self) -> None:
+        if self._halted.is_set():
+            return
+        self._halted.set()
+        self._q.put(None)
+        self._thread.join(timeout=5)
+        self._raft.stop()
+
+    def wait_ready(self) -> None:
+        if self._halted.is_set():
+            raise ChainHaltedError("chain is halted")
+
+    @property
+    def is_leader(self) -> bool:
+        return self._raft.state == "leader"
+
+    @property
+    def leader_id(self) -> Optional[str]:
+        return self._raft.leader_id
+
+    def order(self, env: m.Envelope, config_seq: int) -> None:
+        self.wait_ready()
+        self._q.put(_Submit(env.encode(), False, config_seq))
+
+    def configure(self, env: m.Envelope, config_seq: int) -> None:
+        self.wait_ready()
+        self._q.put(_Submit(env.encode(), True, config_seq))
+
+    # -- submit routing ----------------------------------------------------
+    def _on_chain_msg(self, src: str, msg) -> None:
+        if isinstance(msg, _Submit):
+            try:
+                self._q.put_nowait(msg)
+            except queue.Full:
+                pass                       # backpressure: sender retries
+
+    # -- the leader loop (reference: chain.go:533 run) --------------------
+    def _propose_batch(self, envs: List[m.Envelope], kind: int,
+                       config_seq: int) -> None:
+        """Propose; on leadership loss between check and propose,
+        requeue the envelopes so they are forwarded to the new leader
+        instead of vanishing (the cutter already released them)."""
+        if not self._raft.propose(_encode_batch(envs, kind)):
+            for env in envs:
+                try:
+                    self._q.put_nowait(_Submit(
+                        env.encode(), kind == _CONFIG, config_seq))
+                except queue.Full:
+                    break                  # backpressure: clients retry
+
+    def _run(self) -> None:
+        support = self._support
+        timer_deadline: Optional[float] = None
+        was_leader = False
+        while not self._halted.is_set():
+            timeout = 0.05
+            if timer_deadline is not None:
+                timeout = max(0.0, min(timeout,
+                                       timer_deadline - time.monotonic()))
+            try:
+                sub = self._q.get(timeout=timeout)
+            except queue.Empty:
+                sub = "tick"
+            if sub is None:
+                break
+            if not self.is_leader:
+                if was_leader:
+                    # leadership lost: discard the pending batch —
+                    # clients resubmit via the new leader (reference:
+                    # etcdraft discards the cutter on soft-state change)
+                    support.cutter.cut()
+                    was_leader = False
+                timer_deadline = None
+                # followers forward; never to ourselves (a deposed
+                # leader still listed as leader would spin-loop)
+                lead = self._raft.leader_id
+                if isinstance(sub, _Submit) and lead is not None and \
+                        lead != self.node_id:
+                    self._transport.send(
+                        f"{self.node_id}:chain", f"{lead}:chain", sub)
+                # leader-less: requeue nothing; clients retry
+                continue
+            was_leader = True
+            # -- leader path --
+            if isinstance(sub, _Submit):
+                try:
+                    env = m.Envelope.decode(sub.env_bytes)
+                except Exception:
+                    continue
+                if sub.is_config:
+                    if sub.config_seq < support.sequence():
+                        try:
+                            env, _is_cfg, _seq = \
+                                support.reprocess_config(env)
+                        except Exception:
+                            continue
+                    pending = support.cutter.cut()
+                    if pending:
+                        self._propose_batch(pending, _NORMAL,
+                                            sub.config_seq)
+                        timer_deadline = None
+                    self._propose_batch([env], _CONFIG, sub.config_seq)
+                    continue
+                if sub.config_seq < support.sequence():
+                    try:
+                        support.revalidate_normal(env)
+                    except Exception:
+                        continue
+                batches, pending = support.cutter.ordered(env)
+                for batch in batches:
+                    self._propose_batch(batch, _NORMAL, sub.config_seq)
+                if batches:
+                    timer_deadline = None
+                if pending and timer_deadline is None:
+                    timer_deadline = (time.monotonic()
+                                      + support.batch_timeout_s())
+            # timer expiry cuts the pending batch
+            if timer_deadline is not None and \
+                    time.monotonic() >= timer_deadline:
+                timer_deadline = None
+                batch = support.cutter.cut()
+                if batch:
+                    self._propose_batch(batch, _NORMAL, 0)
+
+    # -- apply (every node, in commit order) ------------------------------
+    def _apply(self, index: int, data: bytes) -> None:
+        """(reference: chain.go:964 apply -> writeBlock :791).  The
+        raft index rides in the block's metadata so restarts skip
+        entries already in the store (see __init__)."""
+        if index <= self._applied_upto:
+            return                         # WAL replay of a stored block
+        kind, envs = _decode_batch(data)
+        support = self._support
+        block = support.writer.create_next_block(envs)
+        md = block.metadata.metadata
+        while len(md) <= self.RAFT_INDEX_MD_SLOT:
+            md.append(b"")
+        md[self.RAFT_INDEX_MD_SLOT] = index.to_bytes(8, "big")
+        if kind == _CONFIG:
+            support.process_config(envs[0], block)
+        else:
+            support.writer.write_block(block)
+        self._applied_upto = index

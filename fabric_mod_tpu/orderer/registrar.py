@@ -35,7 +35,7 @@ class ChainSupport:
     """(reference: multichannel/chainsupport.go ChainSupport)"""
 
     def __init__(self, channel_id: str, store: BlockStore, bundle: Bundle,
-                 signer, csp, verify_many=None):
+                 signer, csp, verify_many=None, chain_factory=None):
         self.channel_id = channel_id
         self.store = store
         self._bundle = bundle
@@ -45,7 +45,12 @@ class ChainSupport:
         self.writer = BlockWriter(store, signer, channel_id)
         self.processor = StandardChannelProcessor(
             self.bundle, signer=signer, verify_many=verify_many)
-        self.chain = SoloChain(self)
+        # consenter selection (reference: consenter registry keyed by
+        # the channel's ConsensusType; solo is the default)
+        if chain_factory is not None:
+            self.chain = chain_factory(self)
+        else:
+            self.chain = SoloChain(self)
 
     # -- bundle access (atomic swap on config commit) --------------------
     def bundle(self) -> Bundle:
@@ -89,11 +94,13 @@ class ChainSupport:
 class Registrar:
     """(reference: multichannel/registrar.go)"""
 
-    def __init__(self, root_dir: str, signer, csp, verify_many=None):
+    def __init__(self, root_dir: str, signer, csp, verify_many=None,
+                 chain_factory=None):
         self._root = root_dir
         self._signer = signer
         self._csp = csp
         self._verify_many = verify_many
+        self._chain_factory = chain_factory
         self._chains: Dict[str, ChainSupport] = {}
         self._lock = threading.Lock()
         os.makedirs(root_dir, exist_ok=True)
@@ -118,7 +125,8 @@ class Registrar:
                 f"directory {channel_id!r} holds channel {cid!r}")
         bundle = Bundle(cid, config, self._csp)
         support = ChainSupport(cid, store, bundle, self._signer, self._csp,
-                               self._verify_many)
+                               self._verify_many,
+                               chain_factory=self._chain_factory)
         self._chains[cid] = support
         support.start()
 
@@ -136,7 +144,8 @@ class Registrar:
                 store.add_block(genesis_block)
             bundle = Bundle(cid, config, self._csp)
             support = ChainSupport(cid, store, bundle, self._signer,
-                                   self._csp, self._verify_many)
+                                   self._csp, self._verify_many,
+                                   chain_factory=self._chain_factory)
             self._chains[cid] = support
         support.start()
         return support
