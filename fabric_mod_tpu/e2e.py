@@ -112,11 +112,12 @@ class Network:
     # -- client operations ------------------------------------------------
     def invoke(self, args: Sequence[bytes],
                endorsing_orgs: Optional[Sequence[str]] = None,
-               chaincode: str = "mycc") -> str:
+               chaincode: str = "mycc", transient=None) -> str:
         orgs = list(endorsing_orgs or list(self.endorsers)[:2])
         return endorse_and_submit(
             self.channel_id, chaincode, args, self.client,
-            [self.endorsers[o] for o in orgs], self.broadcast)
+            [self.endorsers[o] for o in orgs], self.broadcast,
+            transient=transient)
 
     def deliver_client(self, **kw) -> DeliverClient:
         return DeliverClient(self.channel, self.deliver, **kw)

@@ -65,6 +65,12 @@ class QueryExecutor:
         for key, value, _ in self._db.get_state_range(ns, start, end):
             yield key, value
 
+    def get_private_data(self, ns: str, collection: str,
+                         key: str) -> Optional[bytes]:
+        from fabric_mod_tpu.ledger.pvtdata import pvt_namespace
+        got = self._db.get_state(pvt_namespace(ns, collection), key)
+        return got[0] if got else None
+
 
 class TxSimulator(QueryExecutor):
     """Records reads/writes into an RWSetBuilder
@@ -119,8 +125,36 @@ class TxSimulator(QueryExecutor):
         the shim's PutStateMetadata -> rwset metadata writes)."""
         self._rw.add_metadata_write(ns, key, name, value)
 
+    # -- private data (reference: the shim's PutPrivateData path) -----
+    def set_private_data(self, ns: str, collection: str, key: str,
+                         value: bytes) -> None:
+        from fabric_mod_tpu.ledger.pvtdata import pvt_namespace
+        self._writes[(pvt_namespace(ns, collection), key)] = value
+        self._rw.add_pvt_write(ns, collection, key, value)
+
+    def delete_private_data(self, ns: str, collection: str,
+                            key: str) -> None:
+        from fabric_mod_tpu.ledger.pvtdata import pvt_namespace
+        self._writes[(pvt_namespace(ns, collection), key)] = None
+        self._rw.add_pvt_write(ns, collection, key, None)
+
+    def get_private_data(self, ns: str, collection: str,
+                         key: str) -> Optional[bytes]:
+        from fabric_mod_tpu.ledger.pvtdata import pvt_namespace
+        pns = pvt_namespace(ns, collection)
+        if (pns, key) in self._writes:      # read-your-writes
+            return self._writes[(pns, key)]
+        got = self._db.get_state(pns, key)
+        # private reads are NOT recorded in the public read set (the
+        # reference keys hashed reads; omitted — write-only MVCC here)
+        return got[0] if got else None
+
     def done(self) -> m.TxReadWriteSet:
         return self._rw.build()
+
+    def done_pvt(self) -> Optional[m.TxPvtReadWriteSet]:
+        """The plaintext private write-sets for transient staging."""
+        return self._rw.build_pvt()
 
 
 class HistoryDB:
@@ -156,6 +190,7 @@ class KvLedger:
     """One channel's ledger (reference: kv_ledger.go kvLedger)."""
 
     SNAPSHOT_EVERY = 64
+    TRANSIENT_RETENTION_BLOCKS = 100
 
     def __init__(self, ledger_dir: str, ledger_id: str = "ch",
                  durable: bool = True):
@@ -177,7 +212,21 @@ class KvLedger:
         else:
             self.state = VersionedDB.load(self._state_path)
             self.history = HistoryDB()
+        # private data machinery (attach_pvt wires the live stores;
+        # absent, hashed collections commit without plaintext — the
+        # reference's "missing pvt data, reconcile later" stance)
+        self._transient = None
+        self._pvtstore = None
+        self._btl_fn = None
         self._recover()
+
+    def attach_pvt(self, transient_store, pvtdata_store,
+                   btl_fn=None) -> None:
+        """Wire the transient + pvt stores (reference: the coordinator
+        binding of gossip/privdata/coordinator.go:498)."""
+        self._transient = transient_store
+        self._pvtstore = pvtdata_store
+        self._btl_fn = btl_fn or (lambda ns, coll: 0)
 
     def _reset_state_db(self):
         """State ran ahead of a cropped block store: rebuild from
@@ -300,11 +349,90 @@ class KvLedger:
                 # per-tx writes (not the deduped batch) so commit and
                 # recovery replay record identical history
                 self.history.commit(num, tx_writes)
+                self._commit_pvt(num, txs, flags)
             G_HEIGHT.with_labels(self.ledger_id).set(
                 self.blockstore.height)
             if not self._durable and (num + 1) % self.SNAPSHOT_EVERY == 0:
                 self.state.snapshot(self._state_path)
             return flags
+
+    def _commit_pvt(self, num: int, txs, flags) -> None:
+        """Apply plaintext private writes for VALID txs whose hashes
+        the block carries, pulled from the transient store and
+        hash-verified; then run BTL purges (reference:
+        coordinator.go:498 StoreBlock + pvtstatepurgemgmt)."""
+        if self._transient is None:
+            return
+        from fabric_mod_tpu.ledger.pvtdata import (
+            PvtDataMismatchError, pvt_namespace, verify_pvt_against_hashes)
+        batch = UpdateBatch()
+        consumed = []
+        for tx_num, (txid, rwset, _flag) in enumerate(txs):
+            if flags[tx_num] != m.TxValidationCode.VALID or rwset is None:
+                continue
+            hashed = {}                    # (ns, coll) -> HashedRWSet
+            for ns_entry in rwset.ns_rwset:
+                for ch in ns_entry.collection_hashed_rwset:
+                    hashed[(ns_entry.namespace, ch.collection_name)] = \
+                        m.HashedRWSet.decode(ch.hashed_rwset)
+            if not hashed:
+                continue
+            candidates = self._transient.get_by_txid(txid)
+            for (ns, coll), hset in hashed.items():
+                kv = self._find_matching_pvt(candidates, ns, coll, hset)
+                if kv is None:
+                    continue               # missing: reconcile later
+                for w in kv.writes:
+                    pns = pvt_namespace(ns, coll)
+                    if w.is_delete:
+                        batch.delete(pns, w.key, (num, tx_num))
+                    else:
+                        batch.put(pns, w.key, w.value, (num, tx_num))
+                self._pvtstore.commit(num, tx_num, ns, coll, kv,
+                                      self._btl_fn(ns, coll))
+            consumed.append(txid)
+        if len(batch):
+            self.state.apply_updates(batch, num)
+        # purge ALL txids this block carried (valid or not — an
+        # invalidated private tx would otherwise leak its plaintext in
+        # the transient store forever), plus endorsement leftovers
+        # older than the retention window (reference: the commit-path
+        # PurgeBelowHeight)
+        self._transient.purge_by_txids(
+            [txid for txid, _r, _f in txs if txid])
+        self._transient.purge_below_height(
+            max(0, num - self.TRANSIENT_RETENTION_BLOCKS))
+        # BTL expiry: delete only keys whose committed version still
+        # IS the expiring write — a later rewrite has its own expiry
+        # (reference: pvtstatepurgemgmt's version-matched purge)
+        purge_batch = UpdateBatch()
+        for bn, tn, ns, coll, keys in self._pvtstore.expiring_at(num):
+            pns = pvt_namespace(ns, coll)
+            for key in keys:
+                if self.state.get_version(pns, key) == (bn, tn):
+                    purge_batch.delete(pns, key, (num, 0))
+        if len(purge_batch):
+            self.state.apply_updates(purge_batch, num)
+        self._pvtstore.purge(num)
+
+    @staticmethod
+    def _find_matching_pvt(candidates, ns, coll, hset):
+        from fabric_mod_tpu.ledger.pvtdata import (
+            PvtDataMismatchError, verify_pvt_against_hashes)
+        for cand in candidates:
+            for ns_pvt in cand.ns_pvt_rwset:
+                if ns_pvt.namespace != ns:
+                    continue
+                for cp in ns_pvt.collection_pvt_rwset:
+                    if cp.collection_name != coll:
+                        continue
+                    kv = m.KVRWSet.decode(cp.rwset)
+                    try:
+                        verify_pvt_against_hashes(hset, kv)
+                        return kv
+                    except PvtDataMismatchError:
+                        continue           # forged/stale candidate
+        return None
 
     # -- queries ---------------------------------------------------------
     @property

@@ -1,0 +1,150 @@
+"""Private data: transient staging, per-block pvt store, BTL expiry.
+
+(reference: core/transientstore/store.go — endorsement-time staging of
+private write-sets keyed by txid, purged below a block height — and
+core/ledger/pvtdatastorage/store.go — committed per-block private
+data with block-to-live expiry — plus the hash-consistency gate of
+gossip/privdata/coordinator.go:498's StoreBlock.)
+
+The model: private values never enter blocks; blocks carry per-
+collection HASHED read/write sets (kvrwset hashed variants).  The
+plaintext travels out-of-band (transient store now, gossip
+distribution later), and commit verifies sha256(key)/sha256(value)
+against the block's hashes before applying the private writes to the
+ns$$collection state namespace.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from fabric_mod_tpu.protos import messages as m
+
+
+def pvt_namespace(ns: str, collection: str) -> str:
+    """The state-DB namespace private writes land in (reference:
+    privacyenabledstate's ns/collection composite namespaces)."""
+    return f"{ns}$$p{collection}"
+
+
+def hash_key(key: str) -> bytes:
+    return hashlib.sha256(key.encode()).digest()
+
+
+def hash_value(value: bytes) -> bytes:
+    return hashlib.sha256(value).digest()
+
+
+class TransientStore:
+    """Endorsement-time private write-set staging (reference:
+    core/transientstore/store.go — Persist/GetTxPvtRWSetByTxid/
+    PurgeBelowHeight)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # txid -> [(received_at_block, TxPvtReadWriteSet bytes)]
+        self._data: Dict[str, List[Tuple[int, bytes]]] = {}
+
+    def persist(self, txid: str, received_at_block: int,
+                pvt_rwset: m.TxPvtReadWriteSet) -> None:
+        raw = pvt_rwset.encode()
+        with self._lock:
+            entries = self._data.setdefault(txid, [])
+            if any(r == raw for _, r in entries):
+                return                    # N endorsers, one copy
+            entries.append((received_at_block, raw))
+
+    def get_by_txid(self, txid: str) -> List[m.TxPvtReadWriteSet]:
+        with self._lock:
+            return [m.TxPvtReadWriteSet.decode(raw)
+                    for _, raw in self._data.get(txid, [])]
+
+    def purge_by_txids(self, txids) -> None:
+        with self._lock:
+            for t in txids:
+                self._data.pop(t, None)
+
+    def purge_below_height(self, height: int) -> None:
+        """(reference: PurgeBelowHeight — endorsement leftovers)"""
+        with self._lock:
+            for txid in list(self._data):
+                kept = [(h, raw) for h, raw in self._data[txid]
+                        if h >= height]
+                if kept:
+                    self._data[txid] = kept
+                else:
+                    del self._data[txid]
+
+
+class PvtDataStore:
+    """Committed private data per (block, tx, ns, collection) with
+    BTL-based expiry (reference: pvtdatastorage/store.go +
+    pvtstatepurgemgmt).  In-memory index; the authoritative private
+    STATE lives in the (durable) state DB's pvt namespaces — this
+    store serves history/retrieval and drives purges."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (block, tx) -> [(ns, collection, KVRWSet bytes)]
+        self._by_block: Dict[Tuple[int, int],
+                             List[Tuple[str, str, bytes]]] = {}
+        # expiry_block -> [(block, tx, ns, collection, [keys])]
+        self._expiries: Dict[int, List] = {}
+
+    def commit(self, block_num: int, tx_num: int, ns: str,
+               collection: str, kv: m.KVRWSet, btl: int) -> None:
+        with self._lock:
+            self._by_block.setdefault((block_num, tx_num), []).append(
+                (ns, collection, kv.encode()))
+            if btl > 0:
+                keys = [w.key for w in kv.writes]
+                self._expiries.setdefault(block_num + btl + 1, []).append(
+                    (block_num, tx_num, ns, collection, keys))
+
+    def get(self, block_num: int, tx_num: int
+            ) -> List[Tuple[str, str, m.KVRWSet]]:
+        with self._lock:
+            return [(ns, coll, m.KVRWSet.decode(raw))
+                    for ns, coll, raw in
+                    self._by_block.get((block_num, tx_num), [])]
+
+    def expiring_at(self, block_num: int) -> List:
+        """[(block, tx, ns, collection, keys)] whose BTL lapses when
+        `block_num` commits (the purge manager's work list)."""
+        with self._lock:
+            return list(self._expiries.get(block_num, []))
+
+    def purge(self, block_num: int) -> None:
+        with self._lock:
+            for bn, tn, ns, coll, _keys in \
+                    self._expiries.pop(block_num, []):
+                entries = self._by_block.get((bn, tn))
+                if not entries:
+                    continue
+                kept = [(n, c, raw) for n, c, raw in entries
+                        if not (n == ns and c == coll)]
+                if kept:
+                    self._by_block[(bn, tn)] = kept
+                else:
+                    del self._by_block[(bn, tn)]
+
+
+class PvtDataMismatchError(Exception):
+    pass
+
+
+def verify_pvt_against_hashes(hashed: m.HashedRWSet,
+                              pvt_kv: m.KVRWSet) -> None:
+    """The commit gate: plaintext private writes must match the
+    block's hashed write-set exactly (reference: the coordinator's
+    hash checks before StorePvtData)."""
+    want = {(w.key_hash, w.value_hash, w.is_delete)
+            for w in hashed.hashed_writes}
+    got = {(hash_key(w.key),
+            b"" if w.is_delete else hash_value(w.value),
+            w.is_delete)
+           for w in pvt_kv.writes}
+    if want != got:
+        raise PvtDataMismatchError(
+            "private write-set does not match block hashes")

@@ -54,6 +54,7 @@ class RWSetBuilder:
         self._writes: Dict[str, Dict[str, Optional[bytes]]] = {}
         self._ranges: Dict[str, List[m.RangeQueryInfo]] = {}
         self._meta: Dict[str, Dict[str, Dict[str, bytes]]] = {}
+        self._pvt: Dict[Tuple[str, str], Dict[str, Optional[bytes]]] = {}
 
     def add_read(self, ns: str, key: str, version: Optional[Version]) -> None:
         self._reads.setdefault(ns, {}).setdefault(key, version)
@@ -67,6 +68,32 @@ class RWSetBuilder:
         metadata like the VALIDATION_PARAMETER endorsement override)"""
         self._meta.setdefault(ns, {}).setdefault(key, {})[name] = value
 
+    def add_pvt_write(self, ns: str, collection: str, key: str,
+                      value: Optional[bytes]) -> None:
+        """Private write: plaintext goes to the pvt rwset (transient
+        distribution), sha256 hashes go into the PUBLIC rwset's
+        hashed collection section (reference: rwset_builder.go's
+        pvt/hashed dual bookkeeping)."""
+        self._pvt.setdefault((ns, collection), {})[key] = value
+
+    def build_pvt(self) -> Optional[m.TxPvtReadWriteSet]:
+        """The plaintext private write-sets (None when no pvt writes)
+        — what the endorser stages into the transient store."""
+        if not self._pvt:
+            return None
+        by_ns: Dict[str, List[m.CollectionPvtReadWriteSet]] = {}
+        for (ns, coll), writes in sorted(self._pvt.items()):
+            kv = m.KVRWSet(writes=[
+                m.KVWrite(key=k, is_delete=int(v is None),
+                          value=v or b"")
+                for k, v in sorted(writes.items())])
+            by_ns.setdefault(ns, []).append(
+                m.CollectionPvtReadWriteSet(collection_name=coll,
+                                            rwset=kv.encode()))
+        return m.TxPvtReadWriteSet(ns_pvt_rwset=[
+            m.NsPvtReadWriteSet(namespace=ns, collection_pvt_rwset=colls)
+            for ns, colls in sorted(by_ns.items())])
+
     def add_range_query(self, ns: str, start: str, end: str,
                         exhausted: bool,
                         results: List[Tuple[str, Version]]) -> None:
@@ -75,9 +102,22 @@ class RWSetBuilder:
             reads_merkle_hash=range_fingerprint(results)))
 
     def build(self) -> m.TxReadWriteSet:
+        from fabric_mod_tpu.ledger.pvtdata import hash_key, hash_value
+        hashed_by_ns: Dict[str, List[m.CollectionHashedReadWriteSet]] = {}
+        for (ns, coll), writes in sorted(self._pvt.items()):
+            hset = m.HashedRWSet(hashed_writes=[
+                m.KVWriteHash(key_hash=hash_key(k),
+                              is_delete=int(v is None),
+                              value_hash=b"" if v is None
+                              else hash_value(v))
+                for k, v in sorted(writes.items())])
+            hashed_by_ns.setdefault(ns, []).append(
+                m.CollectionHashedReadWriteSet(
+                    collection_name=coll, hashed_rwset=hset.encode()))
         ns_sets = []
         for ns in sorted(set(self._reads) | set(self._writes)
-                         | set(self._ranges) | set(self._meta)):
+                         | set(self._ranges) | set(self._meta)
+                         | set(hashed_by_ns)):
             kv = m.KVRWSet(
                 reads=[m.KVRead(key=k, version=version_proto(v))
                        for k, v in sorted(
@@ -94,7 +134,9 @@ class RWSetBuilder:
                         for n, v in sorted(entries.items())])
                     for k, entries in sorted(
                         self._meta.get(ns, {}).items())])
-            ns_sets.append(m.NsReadWriteSet(namespace=ns, rwset=kv.encode()))
+            ns_sets.append(m.NsReadWriteSet(
+                namespace=ns, rwset=kv.encode(),
+                collection_hashed_rwset=hashed_by_ns.get(ns, [])))
         return m.TxReadWriteSet(data_model=0, ns_rwset=ns_sets)
 
 

@@ -412,7 +412,12 @@ class RaftNode:
                     continue               # already have it
             self._wal.append(idx, eterm, data)
         if msg.leader_commit > self.commit_index:
-            self.commit_index = min(msg.leader_commit, self.last_index)
+            # §5.3: commit at most up to the last entry THIS message
+            # matched/appended — the suffix beyond it is unverified
+            # under reordered delivery
+            last_new = msg.prev_index + len(msg.entries)
+            self.commit_index = max(self.commit_index,
+                                    min(msg.leader_commit, last_new))
             self._apply_committed()
         self._transport.send(self.id, msg.leader, AppendReply(
             self._wal.term, self.id, True, idx))

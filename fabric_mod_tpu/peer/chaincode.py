@@ -23,12 +23,16 @@ class ChaincodeStub:
     which records the read-write set)."""
 
     def __init__(self, namespace: str, simulator, args: List[bytes],
-                 txid: str, channel_id: str):
+                 txid: str, channel_id: str,
+                 transient: Optional[Dict[str, bytes]] = None):
         self.namespace = namespace
         self._sim = simulator
         self.args = args
         self.txid = txid
         self.channel_id = channel_id
+        # side-channel inputs; never part of the ordered tx
+        # (reference: the shim's GetTransient)
+        self.transient = dict(transient or {})
 
     def get_state(self, key: str) -> Optional[bytes]:
         return self._sim.get_state(self.namespace, key)
@@ -46,6 +50,17 @@ class ChaincodeStub:
         """(reference: shim PutStateMetadata — e.g. key-level
         endorsement via the VALIDATION_PARAMETER entry)"""
         self._sim.set_state_metadata(self.namespace, key, name, value)
+
+    # -- private data (reference: shim PutPrivateData/GetPrivateData) --
+    def put_private_data(self, collection: str, key: str,
+                         value: bytes) -> None:
+        self._sim.set_private_data(self.namespace, collection, key, value)
+
+    def get_private_data(self, collection: str, key: str):
+        return self._sim.get_private_data(self.namespace, collection, key)
+
+    def del_private_data(self, collection: str, key: str) -> None:
+        self._sim.delete_private_data(self.namespace, collection, key)
 
 
 class Contract(Protocol):
@@ -105,4 +120,17 @@ class KvContract:
             stub.set_state_metadata(stub.args[1].decode(),
                                     "VALIDATION_PARAMETER", stub.args[2])
             return b"ok"
+        if op == "putpvt":
+            # value arrives via the transient map so it never lands in
+            # the ordered tx (reference: the pvt marbles pattern)
+            value = stub.transient.get("value")
+            if value is None:
+                raise ChaincodeError("putpvt needs transient 'value'")
+            stub.put_private_data(stub.args[1].decode(),
+                                  stub.args[2].decode(), value)
+            return b"ok"
+        if op == "getpvt":
+            val = stub.get_private_data(stub.args[1].decode(),
+                                        stub.args[2].decode())
+            return val if val is not None else b""
         raise ChaincodeError(f"unknown op {op!r}")

@@ -57,7 +57,35 @@ class Channel:
                 ).encode())
         self._vinfo = vinfo
         self.mcs = MessageCryptoService(self.bundle, verifier)
+        # private data plumbing (reference: transientstore + the
+        # privdata coordinator wiring of peer.go createChannel)
+        from fabric_mod_tpu.ledger.pvtdata import (
+            PvtDataStore, TransientStore)
+        self.transient_store = TransientStore()
+        self.pvtdata_store = PvtDataStore()
+        self.ledger.attach_pvt(self.transient_store, self.pvtdata_store,
+                               self._collection_btl)
         self._install_bundle(bundle)
+
+    def _collection_btl(self, ns: str, collection: str) -> int:
+        """BTL from the committed chaincode definition's collection
+        configs (reference: the BTL policy of pvtstatepurgemgmt)."""
+        from fabric_mod_tpu.peer.lifecycle import (
+            LIFECYCLE_NS, definition_key)
+        got = self.ledger.state.get_state(LIFECYCLE_NS,
+                                          definition_key(ns))
+        if got is None:
+            return 0
+        try:
+            d = m.ChaincodeDefinition.decode(got[0])
+            pkg = m.CollectionConfigPackage.decode(d.collections)
+        except Exception:
+            return 0
+        for cc in pkg.config:
+            sc = cc.static_collection_config
+            if sc is not None and sc.name == collection:
+                return sc.block_to_live
+        return 0
 
     # -- bundle lifecycle -------------------------------------------------
     def _install_bundle(self, bundle: Bundle) -> None:

@@ -42,6 +42,9 @@ class LifecycleContract:
             version = stub.args[2].decode()
             sequence = int(stub.args[3].decode())
             policy = stub.args[4] if len(stub.args) > 4 else b""
+            collections = stub.args[5] if len(stub.args) > 5 else b""
+            if collections:                 # must decode as a package
+                m.CollectionConfigPackage.decode(collections)
             prev = stub.get_state(definition_key(name))
             prev_seq = (m.ChaincodeDefinition.decode(prev).sequence
                         if prev else 0)
@@ -51,7 +54,8 @@ class LifecycleContract:
                     f"{prev_seq + 1}")
             d = m.ChaincodeDefinition(
                 sequence=sequence, version=version,
-                endorsement_policy=policy, validation_plugin="vscc")
+                endorsement_policy=policy, validation_plugin="vscc",
+                collections=collections)
             stub.put_state(definition_key(name), d.encode())
             return b"ok"
         if op == "query":

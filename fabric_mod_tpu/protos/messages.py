@@ -349,9 +349,20 @@ class SignedProposal(Msg):
 
 
 @message
+class TransientMapEntry(Msg):
+    FIELDS = ((1, "key", "s"), (2, "value", "b"))
+    key: str = ""
+    value: bytes = b""
+
+
+@message
 class ChaincodeProposalPayload(Msg):
-    FIELDS = ((1, "input", "b"),)
+    # TransientMap (field 2) carries side-channel inputs (private
+    # data); it is STRIPPED when the payload embeds into a tx
+    FIELDS = ((1, "input", "b"),
+              (2, "transient_map", [("m", "TransientMapEntry")]))
     input: bytes = b""          # ChaincodeInvocationSpec bytes
+    transient_map: List["TransientMapEntry"] = _f(default_factory=list)
 
 
 @message
@@ -486,9 +497,13 @@ class KVRWSet(Msg):
 
 @message
 class NsReadWriteSet(Msg):
-    FIELDS = ((1, "namespace", "s"), (2, "rwset", "b"))
+    FIELDS = ((1, "namespace", "s"), (2, "rwset", "b"),
+              (3, "collection_hashed_rwset",
+               [("m", "CollectionHashedReadWriteSet")]))
     namespace: str = ""
     rwset: bytes = b""          # KVRWSet bytes
+    collection_hashed_rwset: List["CollectionHashedReadWriteSet"] = \
+        _f(default_factory=list)
 
 
 @message
@@ -724,12 +739,14 @@ class KVMetadataWrite(Msg):
 class ChaincodeDefinition(Msg):
     FIELDS = ((1, "sequence", "u"), (2, "version", "s"),
               (3, "endorsement_policy", "b"),
-              (4, "validation_plugin", "s"), (5, "init_required", "u"))
+              (4, "validation_plugin", "s"), (5, "init_required", "u"),
+              (6, "collections", "b"))
     sequence: int = 0
     version: str = ""
     endorsement_policy: bytes = b""     # ApplicationPolicy bytes
     validation_plugin: str = ""
     init_required: int = 0
+    collections: bytes = b""            # CollectionConfigPackage bytes
 
 
 # --- orderer/ab.proto (broadcast/deliver service messages) -----------------
@@ -904,3 +921,94 @@ class GossipEnvelope(Msg):
     FIELDS = ((1, "payload", "b"), (2, "signature", "b"))
     payload: bytes = b""        # GossipMessage bytes
     signature: bytes = b""
+
+
+# --- private data: collections + hashed rwsets -----------------------------
+# (reference: peer/collection.proto + ledger/rwset/kvrwset.proto's
+# hashed read/write sets and rwset.proto's TxPvtReadWriteSet)
+
+@message
+class StaticCollectionConfig(Msg):
+    FIELDS = ((1, "name", "s"),
+              (2, "member_orgs_policy", ("m", "SignaturePolicyEnvelope")),
+              (3, "required_peer_count", "i"),
+              (4, "maximum_peer_count", "i"),
+              (5, "block_to_live", "u"),
+              (6, "member_only_read", "u"),
+              (7, "member_only_write", "u"))
+    name: str = ""
+    member_orgs_policy: Optional[SignaturePolicyEnvelope] = None
+    required_peer_count: int = 0
+    maximum_peer_count: int = 0
+    block_to_live: int = 0      # 0 = never expires
+    member_only_read: int = 0
+    member_only_write: int = 0
+
+
+@message
+class CollectionConfig(Msg):
+    FIELDS = ((1, "static_collection_config",
+               ("m", "StaticCollectionConfig")),)
+    static_collection_config: Optional[StaticCollectionConfig] = None
+
+
+@message
+class CollectionConfigPackage(Msg):
+    FIELDS = ((1, "config", [("m", "CollectionConfig")]),)
+    config: List[CollectionConfig] = _f(default_factory=list)
+
+
+@message
+class KVWriteHash(Msg):
+    FIELDS = ((1, "key_hash", "b"), (2, "is_delete", "u"),
+              (3, "value_hash", "b"))
+    key_hash: bytes = b""
+    is_delete: int = 0
+    value_hash: bytes = b""
+
+
+@message
+class KVReadHash(Msg):
+    FIELDS = ((1, "key_hash", "b"), (2, "version", ("m", "Version")))
+    key_hash: bytes = b""
+    version: Optional[Version] = None
+
+
+@message
+class HashedRWSet(Msg):
+    FIELDS = ((1, "hashed_reads", [("m", "KVReadHash")]),
+              (2, "hashed_writes", [("m", "KVWriteHash")]))
+    hashed_reads: List[KVReadHash] = _f(default_factory=list)
+    hashed_writes: List[KVWriteHash] = _f(default_factory=list)
+
+
+@message
+class CollectionHashedReadWriteSet(Msg):
+    FIELDS = ((1, "collection_name", "s"), (2, "hashed_rwset", "b"))
+    collection_name: str = ""
+    hashed_rwset: bytes = b""   # HashedRWSet bytes
+
+
+@message
+class CollectionPvtReadWriteSet(Msg):
+    FIELDS = ((1, "collection_name", "s"), (2, "rwset", "b"))
+    collection_name: str = ""
+    rwset: bytes = b""          # KVRWSet bytes (plaintext)
+
+
+@message
+class NsPvtReadWriteSet(Msg):
+    FIELDS = ((1, "namespace", "s"),
+              (2, "collection_pvt_rwset",
+               [("m", "CollectionPvtReadWriteSet")]))
+    namespace: str = ""
+    collection_pvt_rwset: List[CollectionPvtReadWriteSet] = \
+        _f(default_factory=list)
+
+
+@message
+class TxPvtReadWriteSet(Msg):
+    FIELDS = ((1, "data_model", "i"),
+              (2, "ns_pvt_rwset", [("m", "NsPvtReadWriteSet")]))
+    data_model: int = 0
+    ns_pvt_rwset: List[NsPvtReadWriteSet] = _f(default_factory=list)

@@ -199,11 +199,14 @@ def tx_rwset_and_endorsements(action: m.TransactionAction):
 # --- proposals (the endorsement flow) --------------------------------------
 
 def create_chaincode_proposal(channel_id: str, chaincode_ns: str,
-                              args: Sequence[bytes], creator
+                              args: Sequence[bytes], creator,
+                              transient: "Optional[dict]" = None
                               ) -> "tuple[m.SignedProposal, m.Proposal, str]":
     """Client-side proposal construction + signature
     (reference: protoutil/proputils.go CreateChaincodeProposal +
-    GetSignedProposal).  Returns (signed_proposal, proposal, tx_id)."""
+    GetSignedProposal).  Returns (signed_proposal, proposal, tx_id).
+    `transient` carries side-channel inputs (private data plaintext)
+    that never reach the ordered transaction."""
     nonce = new_nonce()
     creator_bytes = creator.serialize()
     tx_id = compute_tx_id(nonce, creator_bytes)
@@ -218,7 +221,10 @@ def create_chaincode_proposal(channel_id: str, chaincode_ns: str,
     sh = make_signature_header(creator_bytes, nonce)
     header = m.Header(channel_header=ch.encode(),
                       signature_header=sh.encode())
-    ccpp = m.ChaincodeProposalPayload(input=cis.encode())
+    ccpp = m.ChaincodeProposalPayload(
+        input=cis.encode(),
+        transient_map=[m.TransientMapEntry(key=k, value=v)
+                       for k, v in sorted((transient or {}).items())])
     prop = m.Proposal(header=header.encode(), payload=ccpp.encode())
     prop_bytes = prop.encode()
     sp = m.SignedProposal(proposal_bytes=prop_bytes,
@@ -243,8 +249,13 @@ def create_tx_from_responses(prop: m.Proposal,
             raise ValueError("endorsement failed: "
                              f"{r.response.message if r.response else '?'}")
     header = m.Header.decode(prop.header)
+    # strip the transient map: side-channel inputs (private data)
+    # must never enter the ordered transaction (reference:
+    # txutils.go's proposal-payload visibility handling)
+    ccpp = m.ChaincodeProposalPayload.decode(prop.payload)
+    clean_ccpp = m.ChaincodeProposalPayload(input=ccpp.input)
     cap = m.ChaincodeActionPayload(
-        chaincode_proposal_payload=prop.payload,
+        chaincode_proposal_payload=clean_ccpp.encode(),
         action=m.ChaincodeEndorsedAction(
             proposal_response_payload=prp_bytes,
             endorsements=[r.endorsement for r in responses]))

@@ -1,0 +1,173 @@
+"""Private data: transient staging, hashed-write commit gate, BTL
+expiry, and the e2e private round-trip.
+
+(reference test model: integration/pvtdata suites + transientstore/
+pvtdatastorage unit tests — values never in blocks, hashes always,
+plaintext applied only when it matches.)
+"""
+import threading
+import time
+
+import pytest
+
+from fabric_mod_tpu.e2e import Network
+from fabric_mod_tpu.ledger.pvtdata import (
+    PvtDataStore, TransientStore, hash_key, hash_value,
+    pvt_namespace, verify_pvt_against_hashes, PvtDataMismatchError)
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+
+V = m.TxValidationCode
+
+
+@pytest.fixture()
+def net(tmp_path):
+    n = Network(str(tmp_path), batch_timeout="100ms",
+                max_message_count=25)
+    yield n
+    n.close()
+
+
+def _commit_all(net, n_envs, timeout=20.0):
+    client = net.deliver_client()
+    t = threading.Thread(target=lambda: client.run(idle_timeout_s=5.0),
+                         daemon=True)
+    t.start()
+    deadline = time.time() + timeout
+    committed = 0
+    while time.time() < deadline:
+        committed = sum(
+            len(net.ledger.get_block_by_number(i).data.data)
+            for i in range(1, net.ledger.height))
+        if committed >= n_envs:
+            break
+        time.sleep(0.02)
+    client.stop()
+    t.join(timeout=5)
+    return committed
+
+
+def test_hash_verification_gate():
+    kv = m.KVRWSet(writes=[m.KVWrite(key="a", value=b"secret")])
+    hset = m.HashedRWSet(hashed_writes=[m.KVWriteHash(
+        key_hash=hash_key("a"), value_hash=hash_value(b"secret"))])
+    verify_pvt_against_hashes(hset, kv)    # ok
+    forged = m.KVRWSet(writes=[m.KVWrite(key="a", value=b"FORGED")])
+    with pytest.raises(PvtDataMismatchError):
+        verify_pvt_against_hashes(hset, forged)
+
+
+def test_transient_store_lifecycle():
+    ts = TransientStore()
+    pvt = m.TxPvtReadWriteSet()
+    ts.persist("tx1", 5, pvt)
+    ts.persist("tx2", 9, pvt)
+    assert len(ts.get_by_txid("tx1")) == 1
+    ts.purge_below_height(6)
+    assert ts.get_by_txid("tx1") == []
+    assert len(ts.get_by_txid("tx2")) == 1
+    ts.purge_by_txids(["tx2"])
+    assert ts.get_by_txid("tx2") == []
+
+
+def test_e2e_private_roundtrip(net):
+    """putpvt -> ordered block carries only hashes -> commit applies
+    plaintext from the transient store -> getpvt reads it back."""
+    net.invoke([b"putpvt", b"col1", b"acct"],
+               transient={"value": b"hidden-value"})
+    assert _commit_all(net, 1) == 1
+    # the BLOCK must not contain the plaintext
+    blk = net.ledger.get_block_by_number(1)
+    assert b"hidden-value" not in blk.encode()
+    assert all(f == V.VALID for f in protoutil.block_txflags(blk))
+    # committed private state readable through the query executor
+    qe = net.ledger.new_query_executor()
+    assert qe.get_private_data("mycc", "col1", "acct") == b"hidden-value"
+    # and through the chaincode: endorse a getpvt and check the
+    # proposal response payload carries the private value
+    sp, prop, txid = protoutil.create_chaincode_proposal(
+        net.channel_id, "mycc", [b"getpvt", b"col1", b"acct"],
+        net.client)
+    resp = net.endorsers["Org1"].process_proposal(sp)
+    assert resp.response.status == 200
+    assert resp.response.payload == b"hidden-value"
+    # transient store was purged for the committed putpvt tx
+    assert all(net.channel.transient_store.get_by_txid(t) == []
+               for t in list(net.channel.transient_store._data))
+
+
+def test_missing_pvt_data_does_not_block_commit(net):
+    """A peer without the plaintext still commits the block (hashes
+    only); the private state is simply absent until reconciled
+    (reference: the missing-data path of the coordinator)."""
+    net.invoke([b"putpvt", b"col1", b"k"], transient={"value": b"v"})
+    # sabotage: drop the transient data before delivery
+    time.sleep(0.3)                       # let the orderer cut
+    for txid in list(net.channel.transient_store._data):
+        net.channel.transient_store.purge_by_txids([txid])
+    assert _commit_all(net, 1) == 1
+    blk = net.ledger.get_block_by_number(1)
+    assert all(f == V.VALID for f in protoutil.block_txflags(blk))
+    qe = net.ledger.new_query_executor()
+    assert qe.get_private_data("mycc", "col1", "k") is None
+
+
+def test_btl_expiry_purges_private_state(net):
+    """block_to_live=2: private state vanishes after 2 more blocks
+    (reference: pvtstatepurgemgmt BTL expiry)."""
+    pkg = m.CollectionConfigPackage(config=[m.CollectionConfig(
+        static_collection_config=m.StaticCollectionConfig(
+            name="col1", block_to_live=2))])
+    net.invoke([b"commit", b"mycc", b"1.0", b"1", b"", pkg.encode()],
+               chaincode="_lifecycle")
+    assert _commit_all(net, 1) == 1
+    net.invoke([b"putpvt", b"col1", b"ephemeral"],
+               transient={"value": b"short-lived"})
+    assert _commit_all(net, 2) == 2
+    qe = net.ledger.new_query_executor()
+    assert qe.get_private_data("mycc", "col1", "ephemeral") == \
+        b"short-lived"
+    # advance the chain past the BTL window
+    net.invoke([b"put", b"pad1", b"x"])
+    assert _commit_all(net, 3) == 3
+    net.invoke([b"put", b"pad2", b"x"])
+    assert _commit_all(net, 4) == 4
+    net.invoke([b"put", b"pad3", b"x"])
+    assert _commit_all(net, 5) == 5
+    qe = net.ledger.new_query_executor()
+    assert qe.get_private_data("mycc", "col1", "ephemeral") is None
+
+
+def test_btl_rewrite_gets_its_own_expiry_window(net):
+    """A key rewritten later must survive the FIRST write's expiry
+    (regression: version-matched purge, not unconditional delete)."""
+    pkg = m.CollectionConfigPackage(config=[m.CollectionConfig(
+        static_collection_config=m.StaticCollectionConfig(
+            name="col1", block_to_live=2))])
+    net.invoke([b"commit", b"mycc", b"1.0", b"1", b"", pkg.encode()],
+               chaincode="_lifecycle")
+    assert _commit_all(net, 1) == 1            # block 1
+    net.invoke([b"putpvt", b"col1", b"k"], transient={"value": b"v1"})
+    assert _commit_all(net, 2) == 2            # block 2: expiry @ 5
+    net.invoke([b"putpvt", b"col1", b"k"], transient={"value": b"v2"})
+    assert _commit_all(net, 3) == 3            # block 3: expiry @ 6
+    net.invoke([b"put", b"pad1", b"x"])
+    assert _commit_all(net, 4) == 4            # block 4
+    net.invoke([b"put", b"pad2", b"x"])
+    assert _commit_all(net, 5) == 5            # block 5: first expiry
+    qe = net.ledger.new_query_executor()
+    assert qe.get_private_data("mycc", "col1", "k") == b"v2"
+    net.invoke([b"put", b"pad3", b"x"])
+    assert _commit_all(net, 6) == 6            # block 6: second expiry
+    qe = net.ledger.new_query_executor()
+    assert qe.get_private_data("mycc", "col1", "k") is None
+
+
+def test_pvtdata_store_expiry_bookkeeping():
+    store = PvtDataStore()
+    kv = m.KVRWSet(writes=[m.KVWrite(key="k", value=b"v")])
+    store.commit(10, 0, "cc", "col", kv, btl=3)
+    assert store.get(10, 0)[0][:2] == ("cc", "col")
+    assert store.expiring_at(14)          # 10 + 3 + 1
+    store.purge(14)
+    assert store.get(10, 0) == []

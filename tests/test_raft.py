@@ -224,7 +224,6 @@ def test_raft_cluster_orders_identical_chains(cluster):
         for s in supports.values()), timeout=20.0)
     assert ok, {i: s.store.height for i, s in supports.items()}
     # identical chains: same heights, same header hashes
-    heights = {s.store.height for s in supports.values()}
     assert _wait(lambda: len({s.store.height
                               for s in supports.values()}) == 1,
                  timeout=10.0)
