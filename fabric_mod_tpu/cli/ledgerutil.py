@@ -1,0 +1,51 @@
+"""ledger: operator maintenance + snapshot CLI.
+
+(reference: the `peer node reset/rollback/rebuild-dbs` cobra commands
+of internal/peer/node/*.go and the `peer snapshot` CLI.)
+"""
+from __future__ import annotations
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(prog="ledger")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name in ("reset", "rebuild-dbs"):
+        p = sub.add_parser(name)
+        p.add_argument("--ledger", required=True,
+                       help="ledger directory (peer data/<channel>)")
+    p = sub.add_parser("rollback")
+    p.add_argument("--ledger", required=True)
+    p.add_argument("--block", type=int, required=True)
+    p = sub.add_parser("snapshot")
+    p.add_argument("--ledger", required=True)
+    p.add_argument("--channel", required=True)
+    p.add_argument("--output", required=True)
+    p = sub.add_parser("join-from-snapshot")
+    p.add_argument("--snapshot", required=True)
+    p.add_argument("--ledger", required=True)
+    args = ap.parse_args(argv)
+
+    from fabric_mod_tpu.ledger import admin
+    if args.cmd in ("reset", "rebuild-dbs"):
+        admin.rebuild_dbs(args.ledger)
+        print(f"dropped derived stores under {args.ledger}; "
+              f"state rebuilds from blocks on next start")
+    elif args.cmd == "rollback":
+        admin.rollback(args.ledger, args.block)
+        print(f"rolled {args.ledger} back to block {args.block}")
+    elif args.cmd == "snapshot":
+        from fabric_mod_tpu.ledger.kvledger import KvLedger
+        from fabric_mod_tpu.ledger.snapshot import generate_snapshot
+        led = KvLedger(args.ledger, args.channel)
+        meta = generate_snapshot(led, args.output)
+        led.close()
+        print(f"snapshot of {meta['channel']} at height "
+              f"{meta['height']} -> {args.output}")
+    elif args.cmd == "join-from-snapshot":
+        from fabric_mod_tpu.ledger.snapshot import bootstrap_from_snapshot
+        led = bootstrap_from_snapshot(args.snapshot, args.ledger)
+        print(f"bootstrapped {led.ledger_id} at height {led.height} "
+              f"under {args.ledger}")
+        led.close()
+    return 0

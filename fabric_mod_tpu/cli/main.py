@@ -11,7 +11,7 @@ import sys
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv:
-        print("usage: fabric-mod-tpu {cryptogen|configtxgen|node} ...",
+        print("usage: fabric-mod-tpu {cryptogen|configtxgen|node|ledger} ...",
               file=sys.stderr)
         return 2
     tool, rest = argv[0], argv[1:]
@@ -21,6 +21,8 @@ def main(argv=None) -> int:
         from fabric_mod_tpu.cli.configtxgen import main as run
     elif tool == "node":
         from fabric_mod_tpu.cli.node import main as run
+    elif tool == "ledger":
+        from fabric_mod_tpu.cli.ledgerutil import main as run
     else:
         print(f"unknown tool {tool!r}", file=sys.stderr)
         return 2

@@ -1,0 +1,3 @@
+"""Service discovery (reference: discovery/)."""
+from fabric_mod_tpu.discovery.service import (   # noqa: F401
+    DiscoveryService, EndorsementDescriptor, Layout)

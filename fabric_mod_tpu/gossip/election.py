@@ -1,0 +1,66 @@
+"""Per-channel leader election: who runs the deliver client.
+
+(reference: gossip/election/election.go — LeaderElectionService at
+:92, the proposal/declaration rounds of leaderElectionSvcImpl at
+:189-242, and the static-leader mode of the gossip service config.)
+
+Deterministic-minimum election over the converged membership view:
+every peer computes leader = min(PKI-ID) over {self} ∪ alive peers.
+Given the same membership view all peers agree without extra message
+rounds (the reference's proposal rounds exist to stabilize exactly
+this computation under churn; here churn resolves through the
+discovery heartbeats that feed the same view).  `static=True` pins
+leadership to the configured flag instead (reference: the
+org-leader static mode).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+
+class LeaderElectionService:
+    def __init__(self, pki_id: bytes, alive_pki_ids_fn,
+                 on_change: Optional[Callable[[bool], None]] = None,
+                 static: Optional[bool] = None):
+        self._pki = pki_id
+        self._alive = alive_pki_ids_fn     # () -> iterable of pki ids
+        self._on_change = on_change
+        self._static = static
+        self._is_leader = bool(static) if static is not None else False
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self._is_leader
+
+    def tick(self) -> bool:
+        """Recompute leadership; fires on_change on transitions.
+        Returns the current verdict."""
+        if self._static is not None:
+            return self._is_leader
+        candidates = [self._pki] + list(self._alive())
+        new = min(candidates) == self._pki
+        fire = False
+        with self._lock:
+            if new != self._is_leader:
+                self._is_leader = new
+                fire = True
+        if fire and self._on_change is not None:
+            self._on_change(new)
+        return new
+
+    def start(self, interval_s: float = 1.0) -> None:
+        def loop():
+            while not self._stop.wait(interval_s):
+                self.tick()
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -43,6 +43,8 @@ def _tx_ids(block: m.Block) -> List[str]:
 class BlockStore:
     """One channel's block files under `dir_path`."""
 
+    BASE_MARKER = "_base"
+
     def __init__(self, dir_path: str):
         self.dir = dir_path
         os.makedirs(dir_path, exist_ok=True)
@@ -51,8 +53,57 @@ class BlockStore:
         self._height = 0
         self._last_hash = b""
         self._cur_file = 0
+        # snapshot-bootstrapped stores begin above 0: blocks before the
+        # base are pruned history (reference: kvledger snapshot
+        # bootstrap, kv_ledger_provider.go CreateFromSnapshot)
+        base = os.path.join(dir_path, self.BASE_MARKER)
+        if os.path.exists(base):
+            raw = open(base, "rb").read()
+            if len(raw) >= 8 + 32:
+                self._height = struct.unpack_from("<q", raw, 0)[0]
+                self._last_hash = raw[8:40]
+        self._load_pruned_txids()
         self._recover()
         self._fh = open(self._file_path(self._cur_file), "ab")
+
+    @classmethod
+    def write_base_marker(cls, dir_path: str, height: int,
+                          last_hash: bytes) -> None:
+        os.makedirs(dir_path, exist_ok=True)
+        with open(os.path.join(dir_path, cls.BASE_MARKER), "wb") as f:
+            f.write(struct.pack("<q", height))
+            f.write(last_hash[:32].ljust(32, b"\x00"))
+
+    PRUNED_TXIDS = "_pruned_txids"
+    _PRUNED_LOC = (-1, -1)                 # txid exists; block pruned
+
+    @classmethod
+    def write_pruned_txids(cls, dir_path: str, txids) -> None:
+        """Seed the txid index of a snapshot-bootstrapped store so
+        duplicate detection covers the pruned range (reference: the
+        snapshot's txids file import)."""
+        os.makedirs(dir_path, exist_ok=True)
+        with open(os.path.join(dir_path, cls.PRUNED_TXIDS), "wb") as f:
+            for t in txids:
+                b = t.encode()
+                f.write(struct.pack("<I", len(b)))
+                f.write(b)
+
+    def _load_pruned_txids(self) -> None:
+        path = os.path.join(self.dir, self.PRUNED_TXIDS)
+        if not os.path.exists(path):
+            return
+        raw = open(path, "rb").read()
+        pos = 0
+        while pos + 4 <= len(raw):
+            (ln,) = struct.unpack_from("<I", raw, pos)
+            pos += 4
+            self._by_txid.setdefault(raw[pos:pos + ln].decode(),
+                                     self._PRUNED_LOC)
+            pos += ln
+
+    def all_txids(self):
+        return list(self._by_txid)
 
     # -- file layout -----------------------------------------------------
     def _file_path(self, n: int) -> str:
@@ -157,24 +208,30 @@ class BlockStore:
 
     def get_block_by_txid(self, txid: str) -> Optional[m.Block]:
         loc = self._by_txid.get(txid)
-        return self.get_block_by_number(loc[0]) if loc else None
+        if loc is None or loc == self._PRUNED_LOC:
+            return None                    # pruned: known txid, no block
+        return self.get_block_by_number(loc[0])
 
     def get_tx_loc(self, txid: str) -> Optional[Tuple[int, int]]:
         return self._by_txid.get(txid)
 
     def get_tx_by_id(self, txid: str) -> Optional[m.Envelope]:
         loc = self._by_txid.get(txid)
-        if loc is None:
+        if loc is None or loc == self._PRUNED_LOC:
             return None
         block = self.get_block_by_number(loc[0])
         return protoutil.get_envelopes(block)[loc[1]]
 
     def iter_blocks(self, start: int = 0) -> Iterator[m.Block]:
         """Sequential scan through the block files (one open + linear
-        read per file, not one open/seek per block)."""
+        read per file, not one open/seek per block).  Snapshot-
+        bootstrapped stores have no blocks below their base: the scan
+        starts at the first block actually present."""
+        if not self._by_num:
+            return
         cur_fno = None
         raw = b""
-        for num in range(start, self._height):
+        for num in range(max(start, min(self._by_num)), self._height):
             fno, off = self._by_num[num]
             if fno != cur_fno:
                 raw = open(self._file_path(fno), "rb").read()

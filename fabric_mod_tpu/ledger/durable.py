@@ -309,6 +309,12 @@ class DurableStateDB:
         got = self._metadata.get((ns, key))
         return dict(got) if got else None
 
+    def iter_state(self):
+        """Deterministic full scan: (ns, key, value, version) sorted."""
+        for (ns, key) in sorted(self._keydir):
+            off, vlen, ver = self._keydir[(ns, key)]
+            yield ns, key, self._read_value(off, vlen), ver
+
     def get_state_range(self, ns: str, start: str,
                         end: str) -> List[Tuple[str, bytes, Version]]:
         keys = self._keys.get(ns, [])

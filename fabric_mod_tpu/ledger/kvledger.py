@@ -447,6 +447,8 @@ class KvLedger:
         if loc is None:
             return None
         block = self.blockstore.get_block_by_number(loc[0])
+        if block is None:
+            return None                    # known txid, pruned block
         flags = protoutil.block_txflags(block)
         return m.ProcessedTransaction(
             transaction_envelope=protoutil.get_envelopes(block)[loc[1]],

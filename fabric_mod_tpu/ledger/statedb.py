@@ -75,6 +75,14 @@ class VersionedDB:
         got = self._metadata.get((ns, key))
         return dict(got) if got else None
 
+    def iter_state(self):
+        """Deterministic full scan: (ns, key, value, version) sorted —
+        the snapshot generator's input (reference: the stateleveldb
+        full-range iterator behind snapshot export)."""
+        for (ns, key) in sorted(self._data):
+            value, ver = self._data[(ns, key)]
+            yield ns, key, value, ver
+
     def get_state_range(self, ns: str, start: str,
                         end: str) -> List[Tuple[str, bytes, Version]]:
         """(key, value, version) list, start <= key < end ('' end =

@@ -1,0 +1,133 @@
+"""Service discovery, leader election, ACLs, capabilities.
+
+(reference test model: discovery/endorsement tests — layouts for
+AND/OR/OutOf policies — plus gossip/election and aclmgmt suites.)
+"""
+import pytest
+
+from fabric_mod_tpu.channelconfig.capabilities import (
+    ApplicationCapabilities, V2_0)
+from fabric_mod_tpu.discovery import DiscoveryService
+from fabric_mod_tpu.discovery.service import _satisfying_sets
+from fabric_mod_tpu.e2e import Network
+from fabric_mod_tpu.gossip.election import LeaderElectionService
+from fabric_mod_tpu.peer.aclmgmt import ACLError, ACLProvider
+from fabric_mod_tpu.peer.lifecycle import LifecycleValidationInfo
+from fabric_mod_tpu.policy import from_string
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos.protoutil import SignedData
+
+
+@pytest.fixture()
+def net(tmp_path):
+    n = Network(str(tmp_path))
+    yield n
+    n.close()
+
+
+def _members(*orgs_counts):
+    out = {}
+    for org, count in orgs_counts:
+        out[org] = [m.GossipMember(endpoint=f"{org.lower()}-p{i}:7051",
+                                   pki_id=b"%s%d" % (org.encode(), i))
+                    for i in range(count)]
+    return out
+
+
+def _svc(net, membership):
+    return DiscoveryService(
+        net.channel.bundle, net.channel._vinfo, lambda: membership,
+        verify_many=net.verifier.verify_many)
+
+
+def test_satisfying_sets_for_policy_shapes():
+    env = from_string("AND('A.peer', 'B.peer')")
+    sets = _satisfying_sets(env.rule, env.identities)
+    assert sets == [{0: 1, 1: 1}]
+    env = from_string("OR('A.peer', 'B.peer')")
+    sets = _satisfying_sets(env.rule, env.identities)
+    assert {tuple(s.items()) for s in sets} == {((0, 1),), ((1, 1),)}
+    env = from_string("OutOf(2, 'A.peer', 'B.peer', 'C.peer')")
+    sets = _satisfying_sets(env.rule, env.identities)
+    assert len(sets) == 3                  # C(3,2)
+
+
+def test_endorsement_descriptor_layouts(net):
+    membership = _members(("Org1", 2), ("Org2", 1), ("Org3", 0))
+    svc = _svc(net, membership)
+    desc = svc.peers_for_endorsement("mycc")
+    # default policy: MAJORITY of 3 orgs -> 2-of-3 -> 3 layouts
+    assert len(desc.layouts) == 3
+    usable = desc.usable_layouts()
+    # Org3 has no peers: only the Org1+Org2 layout survives
+    assert len(usable) == 1
+    assert usable[0].quantities_by_org == {"Org1": 1, "Org2": 1}
+
+
+def test_descriptor_follows_lifecycle_policy(net):
+    """A committed chaincode definition narrows the layouts."""
+    pol = m.ApplicationPolicy(signature_policy=from_string(
+        "AND('Org1.peer', 'Org3.peer')")).encode()
+
+    class FakeVinfo:
+        def validation_info(self, ns):
+            return "vscc", pol
+    svc = DiscoveryService(net.channel.bundle, FakeVinfo(),
+                           lambda: _members(("Org1", 1), ("Org3", 1)))
+    desc = svc.peers_for_endorsement("mycc")
+    assert len(desc.layouts) == 1
+    assert desc.layouts[0].quantities_by_org == {"Org1": 1, "Org3": 1}
+    assert desc.usable_layouts()
+
+
+def test_discovery_auth_and_config(net):
+    svc = _svc(net, _members(("Org1", 1)))
+    msg = b"discovery-request"
+    sd = SignedData(data=msg, identity=net.client.serialize(),
+                    signature=net.client.sign_message(msg))
+    assert svc.check_access(sd)
+    assert svc.check_access(sd)            # cached path
+    forged = SignedData(data=msg, identity=net.client.serialize(),
+                        signature=b"\x00" * 16)
+    assert not svc.check_access(forged)
+    conf = svc.config()
+    assert set(conf["msps"]) == {"Org1", "Org2", "Org3", "OrdererOrg"}
+
+
+def test_leader_election_deterministic_minimum():
+    flips = []
+    alive = [b"\x05", b"\x09"]
+    svc = LeaderElectionService(b"\x01", lambda: alive,
+                                on_change=flips.append)
+    assert svc.tick() is True              # we are the minimum
+    alive.append(b"\x00")
+    assert svc.tick() is False             # lost leadership
+    assert flips == [True, False]
+    static = LeaderElectionService(b"\xff", lambda: alive, static=True)
+    assert static.tick() is True
+
+
+def test_acl_provider(net):
+    acl = ACLProvider(net.channel.bundle,
+                      verify_many=net.verifier.verify_many)
+    msg = b"proposal-bytes"
+    sd = SignedData(data=msg, identity=net.client.serialize(),
+                    signature=net.client.sign_message(msg))
+    acl.check_acl("peer/Propose", [sd])    # Writers: passes
+    with pytest.raises(ACLError):
+        acl.check_acl("unknown/Resource", [sd])
+    bad = SignedData(data=msg, identity=net.client.serialize(),
+                     signature=b"\x00" * 16)
+    with pytest.raises(ACLError):
+        acl.check_acl("peer/Propose", [bad])
+
+
+def test_capabilities_gates():
+    caps = ApplicationCapabilities([V2_0])
+    assert caps.key_level_endorsement()
+    assert caps.lifecycle_v20()
+    assert caps.supported()
+    unknown = ApplicationCapabilities(["V9_9"])
+    assert not unknown.supported()
+    empty = ApplicationCapabilities([])
+    assert not empty.key_level_endorsement()

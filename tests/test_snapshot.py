@@ -1,0 +1,164 @@
+"""Ledger snapshots + operator maintenance commands.
+
+(reference test model: kvledger snapshot generation/bootstrap tests +
+the node reset/rollback command suites.)
+"""
+import os
+
+import pytest
+
+from fabric_mod_tpu.ledger import admin
+from fabric_mod_tpu.ledger.kvledger import KvLedger
+from fabric_mod_tpu.ledger.snapshot import (
+    SnapshotError, bootstrap_from_snapshot, generate_snapshot,
+    verify_snapshot)
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+
+V = m.TxValidationCode.VALID
+
+
+def _make_block(num, prev, n_txs, led=None):
+    from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder
+    envs = []
+    for i in range(n_txs):
+        b = RWSetBuilder()
+        b.add_write("cc", f"k{num}-{i}", b"v%d" % num)
+        ch = protoutil.make_channel_header(
+            m.HeaderType.ENDORSER_TRANSACTION, "ch",
+            tx_id=f"tx{num}-{i}")
+        sh = protoutil.make_signature_header(b"c", b"n")
+        tx = m.Transaction(actions=[m.TransactionAction(
+            payload=m.ChaincodeActionPayload(
+                action=m.ChaincodeEndorsedAction(
+                    proposal_response_payload=m.ProposalResponsePayload(
+                        extension=m.ChaincodeAction(
+                            results=b.build().encode()).encode()
+                    ).encode())).encode())])
+        payload = protoutil.make_payload(ch, sh, tx.encode())
+        envs.append(m.Envelope(payload=payload.encode()))
+    return protoutil.new_block(num, prev, envs)
+
+
+def _fill(led, n_blocks, txs_per_block=3):
+    prev = (protoutil.block_header_hash(
+        led.get_block_by_number(led.height - 1).header)
+        if led.height else b"")
+    for num in range(led.height, led.height + n_blocks):
+        blk = _make_block(num, prev, txs_per_block)
+        led.commit_block(blk, [V] * txs_per_block)
+        prev = protoutil.block_header_hash(blk.header)
+
+
+def test_snapshot_roundtrip_and_bootstrap(tmp_path):
+    led = KvLedger(str(tmp_path / "src"), "ch")
+    _fill(led, 6)
+    snap = str(tmp_path / "snap")
+    meta = generate_snapshot(led, snap)
+    assert meta["height"] == 6
+    assert verify_snapshot(snap)["channel"] == "ch"
+
+    led2 = bootstrap_from_snapshot(snap, str(tmp_path / "joined"))
+    assert led2.height == 6
+    # state is present, pruned blocks are not
+    assert led2.state.get_state("cc", "k3-1")[0] == b"v3"
+    assert led2.get_block_by_number(2) is None
+    # the chain continues from the snapshot tip
+    tip = led.get_block_by_number(5)
+    blk6 = _make_block(6, protoutil.block_header_hash(tip.header), 2)
+    led2.commit_block(blk6, [V] * 2)
+    assert led2.height == 7
+    assert led2.state.get_state("cc", "k6-0")[0] == b"v6"
+    # reopen: recovery must not try to replay the pruned range
+    led2.close()
+    led3 = KvLedger(str(tmp_path / "joined"), "ch")
+    assert led3.height == 7
+    assert led3.state.get_state("cc", "k6-1")[0] == b"v6"
+    led3.close()
+    led.close()
+
+
+def test_snapshot_preserves_metadata_and_txids(tmp_path):
+    """Key metadata (endorsement pins) and pruned-range txids survive
+    the snapshot join (regressions: SBE policies lost, duplicate txid
+    gate bypassed)."""
+    led = KvLedger(str(tmp_path / "src"), "ch")
+    _fill(led, 3)
+    # attach a VALIDATION_PARAMETER to a key
+    from fabric_mod_tpu.ledger.statedb import UpdateBatch
+    batch = UpdateBatch()
+    batch.put_metadata("cc", "k1-0",
+                       {"VALIDATION_PARAMETER": b"pinned"}, (2, 99))
+    led.state.apply_updates(batch, led.state.savepoint)
+    snap = str(tmp_path / "snap")
+    generate_snapshot(led, snap)
+
+    led2 = bootstrap_from_snapshot(snap, str(tmp_path / "joined"))
+    assert led2.state.get_metadata("cc", "k1-0") == {
+        "VALIDATION_PARAMETER": b"pinned"}
+    # pruned-range txids still trip duplicate detection
+    assert led2.tx_id_exists("tx1-0")
+    assert led2.get_transaction_by_id("tx1-0") is None  # block pruned
+    led2.close()
+    # ...and the index survives a reopen
+    led3 = KvLedger(str(tmp_path / "joined"), "ch")
+    assert led3.tx_id_exists("tx2-1")
+    led3.close()
+    led.close()
+
+
+def test_admin_refuses_bootstrapped_ledgers(tmp_path):
+    led = KvLedger(str(tmp_path / "src"), "ch")
+    _fill(led, 3)
+    snap = str(tmp_path / "snap")
+    generate_snapshot(led, snap)
+    led.close()
+    joined = str(tmp_path / "joined")
+    led2 = bootstrap_from_snapshot(snap, joined)
+    led2.close()
+    with pytest.raises(admin.AdminError):
+        admin.rebuild_dbs(joined)
+    with pytest.raises(admin.AdminError):
+        admin.rollback(joined, 1)
+
+
+def test_snapshot_checksum_tamper_detected(tmp_path):
+    led = KvLedger(str(tmp_path / "src"), "ch")
+    _fill(led, 2)
+    snap = str(tmp_path / "snap")
+    generate_snapshot(led, snap)
+    with open(os.path.join(snap, "state.dat"), "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff")
+    with pytest.raises(SnapshotError):
+        verify_snapshot(snap)
+    led.close()
+
+
+def test_rebuild_dbs_rebuilds_from_blocks(tmp_path):
+    d = str(tmp_path / "led")
+    led = KvLedger(d, "ch")
+    _fill(led, 4)
+    led.close()
+    admin.rebuild_dbs(d)
+    assert not os.path.isdir(os.path.join(d, "state"))
+    led2 = KvLedger(d, "ch")
+    assert led2.height == 4
+    assert led2.state.get_state("cc", "k2-0")[0] == b"v2"
+    assert led2.history.get_history_for_key("cc", "k2-0") == [(2, 0)]
+    led2.close()
+
+
+def test_rollback_truncates_and_rebuilds(tmp_path):
+    d = str(tmp_path / "led")
+    led = KvLedger(d, "ch")
+    _fill(led, 6)
+    led.close()
+    admin.rollback(d, 2)
+    led2 = KvLedger(d, "ch")
+    assert led2.height == 3
+    assert led2.state.get_state("cc", "k2-0")[0] == b"v2"
+    assert led2.state.get_state("cc", "k4-0") is None
+    led2.close()
+    with pytest.raises(admin.AdminError):
+        admin.rollback(d, 99)
