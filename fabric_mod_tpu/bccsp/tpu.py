@@ -180,6 +180,25 @@ class BatchingVerifyService:
             self._q.put((item, fut))
         return fut
 
+    def verify_many(self, items: Sequence[VerifyItem],
+                    timeout: Optional[float] = 30):
+        """The policy-engine seam (same shape as TpuVerifier): submit
+        each item and gather verdicts.  Concurrent callers' items
+        coalesce into shared device batches — this is how ingress
+        paths (broadcast filters, gossip-storm verifies) ride ONE
+        deadline-batched dispatch across many independent requests
+        (SURVEY §2.9 'admission control feeding fixed-size batches').
+        `timeout` bounds the WHOLE call, not each item."""
+        futs = [self.submit(it) for it in items]
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        out = []
+        for f in futs:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            out.append(f.result(remaining))
+        return out
+
     def verify(self, item: VerifyItem, timeout: Optional[float] = 30) -> bool:
         return self.submit(item).result(timeout)
 

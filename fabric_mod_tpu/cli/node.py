@@ -57,21 +57,28 @@ def run_node(genesis_path: str, crypto_dir: str, orderer_org: str,
         genesis_block = m.Block.decode(f.read())
     cid, config = config_from_block(genesis_block)
 
+    ingress = None
+    if peer_cfg.bccsp.upper() == "TPU":
+        from fabric_mod_tpu.bccsp.tpu import (
+            BatchingVerifyService, TpuVerifier)
+        verifier = TpuVerifier()
+        # ingress coalescing only pays when the device is real
+        ingress = BatchingVerifyService(verifier)
+        ingress_verify = ingress.verify_many
+    else:
+        from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
+        verifier = FakeBatchVerifier(csp)
+        ingress_verify = None
+
     orderer_signer = _load_signer(crypto_dir, orderer_org, "orderer", csp)
     registrar = Registrar(os.path.join(data_dir, "orderer"),
-                          orderer_signer, csp)
+                          orderer_signer, csp,
+                          verify_many=ingress_verify)
     if registrar.get_chain(cid) is None:
         support = registrar.create_channel(genesis_block)
     else:
         support = registrar.get_chain(cid)
     broadcast = Broadcast(registrar)
-
-    if peer_cfg.bccsp.upper() == "TPU":
-        from fabric_mod_tpu.bccsp.tpu import TpuVerifier
-        verifier = TpuVerifier()
-    else:
-        from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
-        verifier = FakeBatchVerifier(csp)
 
     ledger_mgr = LedgerManager(os.path.join(data_dir, peer_cfg.ledger_dir))
     ledger = ledger_mgr.create_or_open(cid)
@@ -100,6 +107,8 @@ def run_node(genesis_path: str, crypto_dir: str, orderer_org: str,
     try:
         signal.signal(signal.SIGINT, lambda *_: stop.set())
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        from fabric_mod_tpu.observability.diag import install_signal_dump
+        install_signal_dump()              # SIGUSR1 -> thread stacks
     except ValueError:
         pass                               # not the main thread (tests)
     stop.wait()
@@ -107,6 +116,8 @@ def run_node(genesis_path: str, crypto_dir: str, orderer_org: str,
     ops.stop()
     registrar.close()
     ledger_mgr.close()
+    if ingress is not None:
+        ingress.close()
     return broadcast
 
 

@@ -38,7 +38,8 @@ class Network:
                  orgs: Sequence[str] = ("Org1", "Org2", "Org3"),
                  verifier=None, csp=None,
                  max_message_count: int = 500,
-                 batch_timeout: str = "250ms"):
+                 batch_timeout: str = "250ms",
+                 ingress_batching: bool = False):
         self.channel_id = channel_id
         self.csp = csp or SwCSP()
         if verifier is None:
@@ -80,10 +81,19 @@ class Network:
             max_message_count=max_message_count,
             batch_timeout=batch_timeout)
 
-        # ordering service
+        # ordering service; with ingress batching, concurrent
+        # broadcast submissions coalesce their policy verifies into
+        # shared deadline-batched device dispatches (bccsp/tpu.py
+        # BatchingVerifyService — the admission-control knob)
+        self.ingress_service = None
+        ingress_verify = None
+        if ingress_batching:
+            from fabric_mod_tpu.bccsp.tpu import BatchingVerifyService
+            self.ingress_service = BatchingVerifyService(self.verifier)
+            ingress_verify = self.ingress_service.verify_many
         self.registrar = Registrar(
             os.path.join(root_dir, "orderer"), self.orderer_signer,
-            self.csp)
+            self.csp, verify_many=ingress_verify)
         self.support = self.registrar.create_channel(self.genesis_block)
         self.broadcast = Broadcast(self.registrar)
         self.deliver = DeliverService(self.support)
@@ -98,12 +108,15 @@ class Network:
         if self.ledger.height == 0:
             self.channel.init_from_genesis(self.genesis_block)
 
-        # chaincode + endorsers
+        # chaincode + endorsers (user contract + the system chaincodes)
         from fabric_mod_tpu.peer.lifecycle import (
             LIFECYCLE_NS, LifecycleContract)
+        from fabric_mod_tpu.peer.scc import CsccContract, QsccContract
         self.chaincodes = ChaincodeRegistry()
         self.chaincodes.register("mycc", KvContract())
         self.chaincodes.register(LIFECYCLE_NS, LifecycleContract())
+        self.chaincodes.register("qscc", QsccContract(self.ledger))
+        self.chaincodes.register("cscc", CsccContract(self.channel))
         self.endorsers: Dict[str, Endorser] = {
             org: Endorser(self.channel, self.chaincodes,
                           self.peer_signers[org])
@@ -125,6 +138,8 @@ class Network:
     def close(self) -> None:
         self.registrar.close()
         self.ledger_mgr.close()
+        if self.ingress_service is not None:
+            self.ingress_service.close()
 
 
 def run_pipeline(n_txs: int, verifier, reps_unused: int = 1) -> float:

@@ -1,0 +1,35 @@
+"""Diagnostics: on-demand thread dumps.
+
+(reference: common/diag/goroutine.go + internal/peer/node/signals.go —
+SIGUSR1 logs every goroutine's stack on a running node.)
+"""
+from __future__ import annotations
+
+import faulthandler
+import io
+import signal
+import sys
+import threading
+import traceback
+
+
+def dump_threads(file=None) -> str:
+    """All thread stacks as text (and written to `file` if given)."""
+    out = io.StringIO()
+    frames = sys._current_frames()
+    for thread in threading.enumerate():
+        frame = frames.get(thread.ident)
+        out.write(f"--- thread {thread.name} "
+                  f"(daemon={thread.daemon})\n")
+        if frame is not None:
+            traceback.print_stack(frame, file=out)
+    text = out.getvalue()
+    if file is not None:
+        file.write(text)
+        file.flush()
+    return text
+
+
+def install_signal_dump(sig=signal.SIGUSR1) -> None:
+    """SIGUSR1 -> thread stacks on stderr (reference: signals.go)."""
+    faulthandler.register(sig, file=sys.stderr, all_threads=True)

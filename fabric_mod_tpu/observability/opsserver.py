@@ -83,6 +83,12 @@ class OperationsServer:
                     self._send(200, json.dumps(
                         {"Version": VERSION}).encode(),
                         "application/json")
+                elif self.path == "/debug/threads":
+                    # the goroutine-dump analog (reference:
+                    # common/diag + SIGUSR1 handler)
+                    from fabric_mod_tpu.observability.diag import (
+                        dump_threads)
+                    self._send(200, dump_threads().encode())
                 else:
                     self._send(404, b"not found")
 

@@ -1,0 +1,122 @@
+"""Chaincode packages: build/parse/store.
+
+(reference: core/chaincode/persistence — chaincode_package.go's
+tar.gz format (metadata.json + code.tar.gz) and persistence.go's
+package store keyed by package-id = label:sha256.)
+
+Code payloads here are Python contract sources (the in-process
+runtime's unit of distribution); the same envelope carries external-
+builder artifacts later.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import tarfile
+from typing import List, Optional, Tuple
+
+
+class PackageError(Exception):
+    pass
+
+
+def build_package(label: str, code: bytes,
+                  cc_type: str = "python") -> bytes:
+    """-> tar.gz bytes with metadata.json + code payload
+    (reference: chaincode_package.go's two-member archive)."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        meta = json.dumps({"label": label, "type": cc_type},
+                          sort_keys=True).encode()
+
+        def add(name: str, data: bytes) -> None:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = 0                 # deterministic package id
+            tar.addfile(info, io.BytesIO(data))
+        add("metadata.json", meta)
+        add("code.bin", code)
+    return buf.getvalue()
+
+
+def parse_package(raw: bytes) -> Tuple[str, str, bytes]:
+    """-> (label, type, code) with the reference's validation rules
+    (label required, exactly the two members)."""
+    try:
+        with tarfile.open(fileobj=io.BytesIO(raw), mode="r:gz") as tar:
+            names = sorted(tar.getnames())
+            if names != ["code.bin", "metadata.json"]:
+                raise PackageError(
+                    "package must contain exactly metadata.json "
+                    f"+ code.bin, got {names}")
+            meta = json.loads(
+                tar.extractfile("metadata.json").read())
+            code = tar.extractfile("code.bin").read()
+    except PackageError:
+        raise
+    except Exception as e:
+        raise PackageError(f"bad package: {e}") from e
+    label = meta.get("label", "")
+    if not label or not all(c.isalnum() or c in "._-" for c in label):
+        raise PackageError(f"invalid label {label!r}")
+    return label, meta.get("type", ""), code
+
+
+def package_id(label: str, raw: bytes) -> str:
+    """label:sha256 (reference: persistence.go PackageID)."""
+    return f"{label}:{hashlib.sha256(raw).hexdigest()}"
+
+
+class PackageStore:
+    """Installed-package store (reference: persistence.go Store)."""
+
+    def __init__(self, dir_path: str):
+        self._dir = dir_path
+        os.makedirs(dir_path, exist_ok=True)
+
+    @staticmethod
+    def _validate_id(pkg_id: str) -> None:
+        """Caller-supplied ids hit the filesystem: enforce the
+        label:hexdigest shape (path-traversal guard)."""
+        label, sep, digest = pkg_id.partition(":")
+        if (not sep or not label or len(digest) != 64
+                or not all(c in "0123456789abcdef" for c in digest)
+                or not all(c.isalnum() or c in "._-" for c in label)
+                or ".." in label):
+            raise PackageError(f"invalid package id {pkg_id!r}")
+
+    def _path(self, pkg_id: str) -> str:
+        self._validate_id(pkg_id)
+        return os.path.join(self._dir,
+                            pkg_id.replace(":", ".") + ".tar.gz")
+
+    def save(self, raw: bytes) -> str:
+        label, _t, _code = parse_package(raw)
+        pid = package_id(label, raw)
+        path = self._path(pid)
+        if not os.path.exists(path):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(raw)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        return pid
+
+    def load(self, pkg_id: str) -> Optional[bytes]:
+        path = self._path(pkg_id)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    def list(self) -> List[str]:
+        out = []
+        for name in sorted(os.listdir(self._dir)):
+            if name.endswith(".tar.gz"):
+                base = name[:-len(".tar.gz")]
+                label, _, digest = base.rpartition(".")
+                out.append(f"{label}:{digest}")
+        return out

@@ -111,9 +111,13 @@ class Msg:
                 payload = buf[pos:pos + ln]
                 pos += ln
             elif wt == 5:
+                if pos + 4 > len(buf):
+                    raise ValueError("truncated fixed32 field")
                 payload = buf[pos:pos + 4]
                 pos += 4
             elif wt == 1:
+                if pos + 8 > len(buf):
+                    raise ValueError("truncated fixed64 field")
                 payload = buf[pos:pos + 8]
                 pos += 8
             else:

@@ -1,0 +1,53 @@
+"""Chaincode package build/parse/store (reference:
+core/chaincode/persistence suites)."""
+import pytest
+
+from fabric_mod_tpu.peer.ccpackage import (
+    PackageError, PackageStore, build_package, package_id,
+    parse_package)
+
+
+def test_build_parse_roundtrip():
+    raw = build_package("mycc_1.0", b"def invoke(stub): ...")
+    label, cc_type, code = parse_package(raw)
+    assert (label, cc_type) == ("mycc_1.0", "python")
+    assert code == b"def invoke(stub): ..."
+    # deterministic: same inputs -> same package id
+    assert package_id(label, raw) == package_id(
+        label, build_package("mycc_1.0", b"def invoke(stub): ..."))
+
+
+def test_parse_rejects_bad_packages():
+    with pytest.raises(PackageError):
+        parse_package(b"not a tarball")
+    import io, tarfile
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        info = tarfile.TarInfo("metadata.json")
+        data = b'{"label": "x"}'
+        info.size = len(data)
+        tar.addfile(info, io.BytesIO(data))
+    with pytest.raises(PackageError):
+        parse_package(buf.getvalue())      # missing code.bin
+    bad_label = build_package("evil/../label", b"x")
+    with pytest.raises(PackageError):
+        parse_package(bad_label)
+
+
+def test_store_save_load_list(tmp_path):
+    store = PackageStore(str(tmp_path))
+    raw = build_package("mycc_1.0", b"code")
+    pid = store.save(raw)
+    assert store.load(pid) == raw
+    assert store.save(raw) == pid          # idempotent
+    assert store.list() == [pid]
+    assert store.load("missing:" + "0" * 64) is None
+
+
+def test_store_rejects_traversal_ids(tmp_path):
+    store = PackageStore(str(tmp_path / "pkgs"))
+    (tmp_path / "secret.tar.gz").write_bytes(b"outside")
+    for bad in ("../secret:" + "0" * 64, "a:short", "a/b:" + "0" * 64,
+                "noseparator", "x:" + "Z" * 64):
+        with pytest.raises(PackageError):
+            store.load(bad)

@@ -74,6 +74,10 @@ def test_ops_server_endpoints():
         assert urllib.request.urlopen(req).status == 204
         spec = json.load(urllib.request.urlopen(base + "/logspec"))
         assert spec["spec"] == "ledger=debug:info"
+        # thread dump endpoint (the goroutine-dump analog)
+        dump = urllib.request.urlopen(
+            base + "/debug/threads").read().decode()
+        assert "MainThread" in dump
         # failing health check flips status
         health.register("down", lambda: (_ for _ in ()).throw(
             RuntimeError("broken")))

@@ -1,0 +1,81 @@
+"""System chaincodes: QSCC ledger queries + CSCC config queries.
+
+(reference test model: core/scc/qscc + cscc unit suites, driven
+through the endorser like any chaincode query.)
+"""
+import json
+import threading
+import time
+
+import pytest
+
+from fabric_mod_tpu.e2e import Network
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+
+
+@pytest.fixture()
+def net(tmp_path):
+    n = Network(str(tmp_path), batch_timeout="100ms",
+                max_message_count=25)
+    # commit a little history
+    for i in range(5):
+        n.invoke([b"put", b"q%d" % i, b"v"])
+    client = n.deliver_client()
+    t = threading.Thread(target=lambda: client.run(idle_timeout_s=4),
+                         daemon=True)
+    t.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and n.ledger.height < 2:
+        time.sleep(0.05)
+    client.stop()
+    t.join(timeout=5)
+    yield n
+    n.close()
+
+
+def _query(net, cc, args):
+    sp, _prop, _txid = protoutil.create_chaincode_proposal(
+        net.channel_id, cc, args, net.client)
+    resp = net.endorsers["Org1"].process_proposal(sp)
+    assert resp.response is not None
+    return resp
+
+
+def test_qscc_chain_info_and_blocks(net):
+    resp = _query(net, "qscc", [b"GetChainInfo"])
+    assert resp.response.status == 200
+    info = json.loads(resp.response.payload)
+    assert info["height"] == net.ledger.height
+    assert info["currentBlockHash"]
+
+    resp = _query(net, "qscc", [b"GetBlockByNumber", b"1"])
+    blk = m.Block.decode(resp.response.payload)
+    assert blk.header.number == 1
+
+    txid = protoutil.envelope_channel_header(
+        m.Envelope.decode(blk.data.data[0])).tx_id
+    resp = _query(net, "qscc", [b"GetTransactionByID",
+                                txid.encode()])
+    pt = m.ProcessedTransaction.decode(resp.response.payload)
+    assert pt.validation_code == m.TxValidationCode.VALID
+    resp = _query(net, "qscc", [b"GetBlockByTxID", txid.encode()])
+    assert m.Block.decode(resp.response.payload).header.number == 1
+
+    resp = _query(net, "qscc", [b"GetBlockByNumber", b"999"])
+    assert resp.response.status == 500
+
+
+def test_cscc_config_queries(net):
+    resp = _query(net, "cscc", [b"GetChannelConfig"])
+    cfg = m.Config.decode(resp.response.payload)
+    assert cfg.sequence == net.channel.bundle().sequence
+
+    resp = _query(net, "cscc", [b"GetConfigBlock"])
+    blk = m.Block.decode(resp.response.payload)
+    from fabric_mod_tpu.channelconfig.configtx import config_from_block
+    cid, _config = config_from_block(blk)
+    assert cid == net.channel_id
+
+    resp = _query(net, "cscc", [b"GetChannels"])
+    assert json.loads(resp.response.payload) == [net.channel_id]
