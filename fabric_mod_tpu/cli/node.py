@@ -59,12 +59,22 @@ def run_node(genesis_path: str, crypto_dir: str, orderer_org: str,
 
     ingress = None
     if peer_cfg.bccsp.upper() == "TPU":
+        import functools
         from fabric_mod_tpu.bccsp.tpu import (
             BatchingVerifyService, TpuVerifier)
         verifier = TpuVerifier()
-        # ingress coalescing only pays when the device is real
+        # warm the device program BEFORE serving: cold XLA compiles
+        # run minutes, and ingress futures must never wait on them
+        from fabric_mod_tpu.utils.fixtures import make_verify_items
+        items, _ = make_verify_items(2, n_keys=1, seed=b"warmup")
+        log.info("warming device verify program...")
+        verifier.verify_many(items)
+        log.info("device warm")
+        # ingress coalescing only pays when the device is real; the
+        # whole-call timeout still allows a surprise recompile
         ingress = BatchingVerifyService(verifier)
-        ingress_verify = ingress.verify_many
+        ingress_verify = functools.partial(ingress.verify_many,
+                                           timeout=600)
     else:
         from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
         verifier = FakeBatchVerifier(csp)

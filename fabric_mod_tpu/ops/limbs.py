@@ -154,13 +154,22 @@ def carry2(x: jnp.ndarray) -> jnp.ndarray:
     return x
 
 
+def _pad_last(x: jnp.ndarray, left: int, right: int) -> jnp.ndarray:
+    cfg = [(0, 0, 0)] * (x.ndim - 1) + [(left, right, 0)]
+    return jax.lax.pad(x, jnp.int32(0), cfg)
+
+
 def sb_mul_full(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Schoolbook product columns: (..., K) x (..., K) -> (..., 2K-1)."""
-    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    out = jnp.zeros(shape + (2 * K - 1,), jnp.int32)
-    for i in range(K):
-        out = out.at[..., i:i + K].add(a[..., i:i + 1] * b)
-    return out
+    """Schoolbook product columns: (..., K) x (..., K) -> (..., 2K-1).
+
+    Dense pad-and-sum instead of scatter-adds: XLA lowers `.at[].add`
+    to scatter, which is pathologically slow to compile (and run) on
+    CPU and not free on TPU; shifted pads + one stacked reduction is
+    the same arithmetic as pure dense ops.
+    """
+    rows = [_pad_last(a[..., i:i + 1] * b, i, K - 1 - i)
+            for i in range(K)]
+    return jnp.sum(jnp.stack(rows, axis=0), axis=0)
 
 
 def sb_sqr_full(a: jnp.ndarray) -> jnp.ndarray:
@@ -172,21 +181,22 @@ def sb_sqr_full(a: jnp.ndarray) -> jnp.ndarray:
     |2*a_i*a_j| < 2**25 keeps columns < 2**29 — inside carry2's domain.
     """
     shape = a.shape[:-1]
-    out = jnp.zeros(shape + (2 * K - 1,), jnp.int32)
-    out = out.at[..., 0::2].add(a * a)                 # a_i^2 -> column 2i
+    # diagonal a_i^2 lands at column 2i: interleave with zeros
+    sq = a * a
+    diag = jnp.stack([sq, jnp.zeros_like(sq)], axis=-1)
+    diag = diag.reshape(shape + (2 * K,))[..., :2 * K - 1]
+    rows = [diag]
     for i in range(K - 1):
-        out = out.at[..., 2 * i + 1:i + K].add(
-            2 * a[..., i:i + 1] * a[..., i + 1:])      # 2 a_i a_j -> col i+j
-    return out
+        cross = 2 * a[..., i:i + 1] * a[..., i + 1:]   # cols 2i+1..i+K-1
+        rows.append(_pad_last(cross, 2 * i + 1, K - 1 - i))
+    return jnp.sum(jnp.stack(rows, axis=0), axis=0)
 
 
 def sb_mul_low(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Low K columns of the schoolbook product (i.e. a*b mod-ish R)."""
-    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    out = jnp.zeros(shape + (K,), jnp.int32)
-    for i in range(K):
-        out = out.at[..., i:].add(a[..., i:i + 1] * b[..., :K - i])
-    return out
+    rows = [_pad_last(a[..., i:i + 1] * b[..., :K - i], i, 0)
+            for i in range(K)]
+    return jnp.sum(jnp.stack(rows, axis=0), axis=0)
 
 
 def carry_mod_r(x: jnp.ndarray) -> jnp.ndarray:

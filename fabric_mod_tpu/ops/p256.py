@@ -294,8 +294,11 @@ def verify_core(e: jnp.ndarray, r: jnp.ndarray, s: jnp.ndarray,
         jnp.stack([u1_w, u2_w], axis=-1), -2, 0)             # (NW, batch, 2)
 
     def step(acc, w2):
-        for _ in range(WINDOW):
-            acc = point_double(acc, fp, b_m)
+        # WINDOW doublings as a fori_loop: the traced scan body holds
+        # ONE doubling instead of WINDOW unrolled copies — measurably
+        # faster XLA compiles with identical math
+        acc = jax.lax.fori_loop(
+            0, WINDOW, lambda _i, a: point_double(a, fp, b_m), acc)
         oh_q = jax.nn.one_hot(w2[..., 1], TABLE, dtype=jnp.int32)
         acc = point_add(acc, tuple(
             jnp.einsum("...i,...ik->...k", oh_q, q_table[c])

@@ -29,18 +29,9 @@ def block_signed_data(block: m.Block, md_value: bytes,
     return md_value + sig_header + block.header.encode()
 
 
-def last_config_index(block: m.Block) -> Optional[int]:
-    """Read the last-config pointer out of a committed block's
-    SIGNATURES metadata (None if absent/unparseable)."""
-    md = block.metadata.metadata if block.metadata else []
-    idx = m.BlockMetadataIndex.SIGNATURES
-    if len(md) <= idx or not md[idx]:
-        return None
-    try:
-        meta = m.Metadata.decode(md[idx])
-        return m.LastConfig.decode(meta.value).index
-    except Exception:
-        return None
+# generic block-metadata decoding lives in protoutil; kept as an
+# alias here for the orderer-side callers
+last_config_index = protoutil.block_last_config_index
 
 
 class BlockWriter:

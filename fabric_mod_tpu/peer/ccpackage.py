@@ -59,9 +59,18 @@ def parse_package(raw: bytes) -> Tuple[str, str, bytes]:
     except Exception as e:
         raise PackageError(f"bad package: {e}") from e
     label = meta.get("label", "")
-    if not label or not all(c.isalnum() or c in "._-" for c in label):
+    if not _label_ok(label):
         raise PackageError(f"invalid label {label!r}")
     return label, meta.get("type", ""), code
+
+
+def _label_ok(label: str) -> bool:
+    """One label rule shared by parse and the store's id guard: the
+    reference's regex also rejects consecutive/edge separators."""
+    return bool(label) and \
+        all(c.isalnum() or c in "._-" for c in label) and \
+        ".." not in label and not label.startswith(".") and \
+        not label.endswith(".")
 
 
 def package_id(label: str, raw: bytes) -> str:
@@ -81,10 +90,9 @@ class PackageStore:
         """Caller-supplied ids hit the filesystem: enforce the
         label:hexdigest shape (path-traversal guard)."""
         label, sep, digest = pkg_id.partition(":")
-        if (not sep or not label or len(digest) != 64
+        if (not sep or len(digest) != 64
                 or not all(c in "0123456789abcdef" for c in digest)
-                or not all(c.isalnum() or c in "._-" for c in label)
-                or ".." in label):
+                or not _label_ok(label)):
             raise PackageError(f"invalid package id {pkg_id!r}")
 
     def _path(self, pkg_id: str) -> str:

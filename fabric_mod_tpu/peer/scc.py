@@ -76,13 +76,11 @@ class CsccContract:
         if op == "GetConfigBlock":
             ledger = self._channel.ledger
             # walk back from the tip's last-config pointer
-            from fabric_mod_tpu.orderer.blockwriter import (
-                last_config_index)
             h = ledger.height
             if h == 0:
                 raise ChaincodeError("empty chain")
             tip = ledger.get_block_by_number(h - 1)
-            lc = last_config_index(tip)
+            lc = protoutil.block_last_config_index(tip)
             blk = ledger.get_block_by_number(lc or 0)
             if blk is None:
                 raise ChaincodeError("config block pruned")

@@ -263,3 +263,18 @@ def create_tx_from_responses(prop: m.Proposal,
         header=header.signature_header, payload=cap.encode())])
     payload = m.Payload(header=header, data=tx.encode())
     return sign_envelope(payload, creator)
+
+
+def block_last_config_index(block: m.Block) -> "Optional[int]":
+    """The last-config pointer from a committed block's SIGNATURES
+    metadata, or None (reference: protoutil/blockutils.go
+    GetLastConfigIndexFromBlock)."""
+    md = block.metadata.metadata if block.metadata else []
+    idx = m.BlockMetadataIndex.SIGNATURES
+    if len(md) <= idx or not md[idx]:
+        return None
+    try:
+        meta = m.Metadata.decode(md[idx])
+        return m.LastConfig.decode(meta.value).index
+    except Exception:
+        return None
