@@ -63,12 +63,17 @@ def run_node(genesis_path: str, crypto_dir: str, orderer_org: str,
         from fabric_mod_tpu.bccsp.tpu import (
             BatchingVerifyService, TpuVerifier)
         verifier = TpuVerifier()
-        # warm the device program BEFORE serving: cold XLA compiles
-        # run minutes, and ingress futures must never wait on them
+        # warm EVERY bucket's device program BEFORE serving: cold XLA
+        # compiles run minutes, ingress futures must never wait on
+        # them, and a flush can select any bucket size
+        from fabric_mod_tpu.bccsp.tpu import BUCKETS
         from fabric_mod_tpu.utils.fixtures import make_verify_items
-        items, _ = make_verify_items(2, n_keys=1, seed=b"warmup")
-        log.info("warming device verify program...")
-        verifier.verify_many(items)
+        items, _ = make_verify_items(BUCKETS[-1], n_keys=4,
+                                     seed=b"warmup")
+        for bucket in BUCKETS:
+            log.info("warming device verify program (bucket %d)...",
+                     bucket)
+            verifier.verify_many(items[:bucket])
         log.info("device warm")
         # ingress coalescing only pays when the device is real; the
         # whole-call timeout still allows a surprise recompile

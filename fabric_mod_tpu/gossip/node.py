@@ -15,7 +15,7 @@ import math
 import random
 import threading
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from fabric_mod_tpu.gossip.comm import GossipComm, InProcNetwork
 from fabric_mod_tpu.gossip.discovery import Discovery
@@ -123,6 +123,8 @@ class GossipNode:
             self._handle_request(src_pki_id, msg)
         elif msg.data_update is not None:
             self._handle_update(msg)
+        elif msg.private_data is not None:
+            self._handle_private(msg)
 
     def _verify_with_carried_identity(self, env, payload, sig) -> bool:
         """Bootstrap: an alive message carries its own identity —
@@ -170,6 +172,67 @@ class GossipNode:
         if self.state.add_block(block):
             # forward fresh blocks (push epidemic)
             self.comm.broadcast(self._pick_peers(), msg)
+
+    # -- private data distribution (reference: gossip/privdata/
+    # -- distributor.go:458 — plaintext to ELIGIBLE peers only) ----------
+    def distribute_pvt(self, txid: str, pvt_rwset,
+                       eligible: Callable[[bytes], bool]) -> int:
+        """Send a private write-set to ELIGIBLE alive peers only — the
+        filter is mandatory (fail-closed: the reference's distributor
+        always applies the collection AccessFilter; an optional filter
+        would fail-open the confidentiality property this exists
+        for).  Returns peers reached."""
+        msg = m.GossipMessage(
+            nonce=self._rng.getrandbits(63),
+            channel=self._channel.channel_id.encode(),
+            private_data=m.PvtDataElement(
+                txid=txid, payload=pvt_rwset.encode()))
+        sent = 0
+        for member in self.discovery.alive_members():
+            if member.endpoint == self.endpoint:
+                continue
+            ident = self.mapper.get(member.pki_id)
+            if ident is None or not eligible(ident):
+                continue
+            if self.comm.send(member.endpoint, msg):
+                sent += 1
+        return sent
+
+    def _handle_private(self, msg: m.GossipMessage) -> None:
+        """Received plaintext goes to the transient store; the commit
+        path hash-verifies it against the block before applying
+        (reference: the coordinator's transient persist on
+        dissemination).  Channel-checked; the store itself bounds
+        growth against flooding."""
+        pd = msg.private_data
+        if not pd.txid or not pd.payload:
+            return
+        if msg.channel != self._channel.channel_id.encode():
+            return                          # cross-channel leak guard
+        try:
+            pvt = m.TxPvtReadWriteSet.decode(pd.payload)
+        except Exception:
+            return
+        self._channel.transient_store.persist(
+            pd.txid, self._channel.ledger.height, pvt)
+
+    def eligibility_by_policy(self, member_orgs_policy):
+        """eligible(identity_bytes) closure for a collection's
+        member_orgs_policy (SignaturePolicyEnvelope): org-principal
+        check over the peer's identity — sufficient for membership
+        (no signature to check at dissemination time; the reference's
+        AccessFilter does the same principal-only evaluation)."""
+        from fabric_mod_tpu.policy.cauthdsl import CompiledPolicy
+        msp_mgr = self._channel.bundle().msp_manager
+        pol = CompiledPolicy(member_orgs_policy, msp_mgr)
+
+        def eligible(identity_bytes: bytes) -> bool:
+            try:
+                ident = msp_mgr.deserialize_identity(identity_bytes)
+            except Exception:
+                return False
+            return pol.satisfied_by_principals([ident])
+        return eligible
 
     # -- pull engine (reference: algo/pull.go) ----------------------------
     def pull_tick(self) -> None:

@@ -39,10 +39,17 @@ def hash_value(value: bytes) -> bytes:
 class TransientStore:
     """Endorsement-time private write-set staging (reference:
     core/transientstore/store.go — Persist/GetTxPvtRWSetByTxid/
-    PurgeBelowHeight)."""
+    PurgeBelowHeight).  Bounded: gossip-delivered plaintext is
+    attacker-influenceable, so growth past `max_entries` drops new
+    arrivals (commit-time reconciliation recovers them later) instead
+    of growing without bound."""
 
-    def __init__(self):
+    MAX_ENTRIES = 10_000
+
+    def __init__(self, max_entries: int = MAX_ENTRIES):
         self._lock = threading.Lock()
+        self._max = max_entries
+        self._count = 0
         # txid -> [(received_at_block, TxPvtReadWriteSet bytes)]
         self._data: Dict[str, List[Tuple[int, bytes]]] = {}
 
@@ -53,7 +60,12 @@ class TransientStore:
             entries = self._data.setdefault(txid, [])
             if any(r == raw for _, r in entries):
                 return                    # N endorsers, one copy
+            if self._count >= self._max:
+                if not entries:
+                    del self._data[txid]
+                return                    # flood guard: drop new
             entries.append((received_at_block, raw))
+            self._count += 1
 
     def get_by_txid(self, txid: str) -> List[m.TxPvtReadWriteSet]:
         with self._lock:
@@ -63,7 +75,9 @@ class TransientStore:
     def purge_by_txids(self, txids) -> None:
         with self._lock:
             for t in txids:
-                self._data.pop(t, None)
+                gone = self._data.pop(t, None)
+                if gone:
+                    self._count -= len(gone)
 
     def purge_below_height(self, height: int) -> None:
         """(reference: PurgeBelowHeight — endorsement leftovers)"""
@@ -71,6 +85,7 @@ class TransientStore:
             for txid in list(self._data):
                 kept = [(h, raw) for h, raw in self._data[txid]
                         if h >= height]
+                self._count -= len(self._data[txid]) - len(kept)
                 if kept:
                     self._data[txid] = kept
                 else:

@@ -64,13 +64,19 @@ def parse_package(raw: bytes) -> Tuple[str, str, bytes]:
     return label, meta.get("type", ""), code
 
 
+_LABEL_RE = None
+
+
 def _label_ok(label: str) -> bool:
-    """One label rule shared by parse and the store's id guard: the
-    reference's regex also rejects consecutive/edge separators."""
-    return bool(label) and \
-        all(c.isalnum() or c in "._-" for c in label) and \
-        ".." not in label and not label.startswith(".") and \
-        not label.endswith(".")
+    """One label rule shared by parse and the store's id guard — the
+    reference's regex: alnum runs joined by single . + - _ separators
+    (no edge or consecutive separators)."""
+    global _LABEL_RE
+    if _LABEL_RE is None:
+        import re
+        _LABEL_RE = re.compile(
+            r"^[a-zA-Z0-9]+([.+\-_][a-zA-Z0-9]+)*$")
+    return bool(_LABEL_RE.match(label))
 
 
 def package_id(label: str, raw: bytes) -> str:

@@ -167,6 +167,14 @@ class CompiledPolicy:
             idents.append(ident)
         return PendingEval(self._closure, idents, slots)
 
+    def satisfied_by_principals(self, idents: Sequence) -> bool:
+        """Principal-only evaluation — no signatures involved (the
+        reference's AccessFilter use: is this SET OF IDENTITIES inside
+        the policy, e.g. collection membership checks at private-data
+        dissemination time)."""
+        used = [False] * len(idents)
+        return self._closure(list(idents), used)
+
     # -- phases 1+2+3 standalone -----------------------------------------
     def evaluate_signed_data(self, signed_datas: Sequence[SignedData],
                              verify_many: Optional[Callable] = None) -> bool:

@@ -896,15 +896,23 @@ class DataUpdate(Msg):
 
 
 @message
+class PvtDataElement(Msg):
+    FIELDS = ((1, "txid", "s"), (2, "payload", "b"))
+    txid: str = ""
+    payload: bytes = b""        # TxPvtReadWriteSet bytes
+
+
+@message
 class GossipMessage(Msg):
-    # oneof payload: alive/data/hello/digest/request/update
+    # oneof payload: alive/data/hello/digest/request/update/private
     FIELDS = ((1, "nonce", "u"), (2, "channel", "b"), (3, "tag", "i"),
               (5, "alive_msg", ("m", "AliveMessage")),
               (6, "data_msg", ("m", "DataMessage")),
               (7, "hello", ("m", "GossipHello")),
               (8, "data_dig", ("m", "DataDigest")),
               (9, "data_req", ("m", "DataRequest")),
-              (10, "data_update", ("m", "DataUpdate")))
+              (10, "data_update", ("m", "DataUpdate")),
+              (11, "private_data", ("m", "PvtDataElement")))
     nonce: int = 0
     channel: bytes = b""
     tag: int = 0
@@ -914,6 +922,7 @@ class GossipMessage(Msg):
     data_dig: Optional[DataDigest] = None
     data_req: Optional[DataRequest] = None
     data_update: Optional[DataUpdate] = None
+    private_data: Optional["PvtDataElement"] = None
 
 
 @message

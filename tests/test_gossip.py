@@ -167,6 +167,32 @@ def test_tampered_gossip_block_dropped(world):
     assert peers[1]._channel.ledger.height == 1   # only genesis
 
 
+def test_private_data_distribution_respects_membership(world):
+    """Plaintext private write-sets travel only to peers whose org
+    satisfies the collection policy; receivers stage them in their
+    transient stores for the commit path (reference:
+    gossip/privdata/distributor.go:458 + AccessFilter)."""
+    from fabric_mod_tpu.policy import from_string
+    net, _, peers = world
+    _connect_all(peers)
+    # peers: 0=Org1, 1=Org2, 2=Org3; collection members: Org1+Org2
+    pvt = m.TxPvtReadWriteSet(ns_pvt_rwset=[m.NsPvtReadWriteSet(
+        namespace="mycc",
+        collection_pvt_rwset=[m.CollectionPvtReadWriteSet(
+            collection_name="col1",
+            rwset=m.KVRWSet(writes=[m.KVWrite(
+                key="secret", value=b"plaintext")]).encode())])])
+    policy = from_string("OR('Org1.peer', 'Org2.peer')")
+    eligible = peers[0].eligibility_by_policy(policy)
+    sent = peers[0].distribute_pvt("txA", pvt, eligible)
+    assert sent == 1                       # only peer1 (Org2)
+    got = peers[1]._channel.transient_store.get_by_txid("txA")
+    assert len(got) == 1
+    assert got[0].ns_pvt_rwset[0].namespace == "mycc"
+    # the non-member Org3 peer received nothing
+    assert peers[2]._channel.transient_store.get_by_txid("txA") == []
+
+
 def test_unknown_identity_messages_ignored(world):
     net, _, peers = world
     _connect_all(peers)
