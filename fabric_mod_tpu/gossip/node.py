@@ -229,6 +229,10 @@ class GossipNode:
         def eligible(identity_bytes: bytes) -> bool:
             try:
                 ident = msp_mgr.deserialize_identity(identity_bytes)
+                # full validation (chain, expiry, CRLs) — a revoked
+                # peer must stop receiving plaintext even though its
+                # identity was admitted to the mapper earlier
+                msp_mgr.validate(ident)
             except Exception:
                 return False
             return pol.satisfied_by_principals([ident])

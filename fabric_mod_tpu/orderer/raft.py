@@ -83,6 +83,21 @@ class AppendReply:
         self.match_index = match_index
 
 
+class InstallSnapshot:
+    """Leader→lagging-follower state transfer when the entries the
+    follower needs were compacted away (reference: etcdraft snapshot
+    catch-up, chain.go:880 + storage.go:299 TakeSnapshot)."""
+
+    __slots__ = ("term", "leader", "last_index", "last_term", "data")
+
+    def __init__(self, term, leader, last_index, last_term, data):
+        self.term = term
+        self.leader = leader
+        self.last_index = last_index   # last raft index the snapshot covers
+        self.last_term = last_term
+        self.data = data               # app-defined state pointer
+
+
 class RaftTransport:
     """node_id -> deliver(msg).  In-process registry (the test fabric);
     a gRPC Step-stream adapter registers the same surface."""
@@ -110,20 +125,33 @@ class RaftTransport:
 
 # --- WAL -------------------------------------------------------------------
 
-_HARDSTATE, _ENTRY = 0, 1
+_HARDSTATE, _ENTRY, _SNAPSHOT = 0, 1, 2
 
 
 class RaftWAL:
-    """Append-only persistence of (term, voted_for) + log entries
-    (reference: etcd WAL via storage.go:244; same crash contract —
-    torn tails cropped by CRC framing)."""
+    """Append-only persistence of (term, voted_for) + log entries +
+    snapshot markers (reference: etcd WAL via storage.go:244; same
+    crash contract — torn tails cropped by CRC framing).
+
+    A snapshot marker (snap_index, snap_term, app data) says "entries
+    ≤ snap_index are folded into app state"; `compact` rewrites the
+    file to a marker plus the retained suffix, bounding WAL size the
+    way storage.go:299/gc does.  Like etcd, compaction keeps a margin
+    of entries BEHIND snap_index (SnapshotCatchUpEntries) so slightly
+    lagging followers are repaired by AppendEntries, not snapshots —
+    hence the separate log base: entries[i] holds raft index
+    base + i + 1, with base ≤ snap_index ≤ last_index."""
 
     def __init__(self, path: str):
         self._path = path
         self.term = 0
         self.voted_for: Optional[str] = None
-        self.entries: List[Tuple[int, bytes]] = []   # 1-based index
-        self._truncations = 0
+        self.snap_index = 0
+        self.snap_term = 0
+        self.snap_data = b""
+        self.base = 0            # index of the entry before entries[0]
+        self.base_term = 0
+        self.entries: List[Tuple[int, bytes]] = []
         if os.path.exists(path):
             self._replay()
         self._f = open(path, "ab")
@@ -151,8 +179,19 @@ class RaftWAL:
                 data = payload[17:]
                 # upto = the index this entry lands at; truncate any
                 # conflicting suffix (log repair happened before write)
-                del self.entries[upto - 1:]
-                self.entries.append((eterm, data))
+                local = upto - self.base
+                if local >= 1:
+                    del self.entries[local - 1:]
+                    self.entries.append((eterm, data))
+            elif kind == _SNAPSHOT:
+                (sidx, sterm, base,
+                 bterm) = struct.unpack_from("<qqqq", payload, 1)
+                self.snap_index = sidx
+                self.snap_term = sterm
+                self.base = base
+                self.base_term = bterm
+                self.snap_data = payload[33:]
+                self.entries = []
             good_end = end
             pos = end
         if good_end < len(raw):
@@ -163,6 +202,25 @@ class RaftWAL:
         return struct.pack("<II", len(payload),
                            zlib.crc32(payload)) + payload
 
+    # -- index helpers (1-based raft indices) ----------------------------
+    @property
+    def last_index(self) -> int:
+        return self.base + len(self.entries)
+
+    def term_at(self, index: int) -> int:
+        """Term of `index`; only valid for base ≤ index ≤ last."""
+        if index == self.base:
+            return self.base_term
+        return self.entries[index - self.base - 1][0]
+
+    def entry(self, index: int) -> Tuple[int, bytes]:
+        return self.entries[index - self.base - 1]
+
+    def entries_from(self, index: int, limit: int) -> List[Tuple[int, bytes]]:
+        s = index - self.base - 1
+        return self.entries[s:s + limit]
+
+    # -- writes -----------------------------------------------------------
     def save_hardstate(self, term: int, voted_for: Optional[str]) -> None:
         self.term = term
         self.voted_for = voted_for
@@ -175,13 +233,64 @@ class RaftWAL:
 
     def append(self, index: int, term: int, data: bytes) -> None:
         """Write entry at 1-based `index`, truncating conflicts."""
-        del self.entries[index - 1:]
+        local = index - self.base
+        if local < 1:
+            return                         # already folded into snapshot
+        del self.entries[local - 1:]
         self.entries.append((term, data))
         payload = (bytes([_ENTRY]) + struct.pack("<qq", term, index)
                    + data)
         self._f.write(self._frame(payload))
         self._f.flush()
         os.fsync(self._f.fileno())
+
+    def _rewrite(self, snap_index: int, snap_term: int, snap_data: bytes,
+                 base: int, base_term: int,
+                 keep: List[Tuple[int, bytes]]) -> None:
+        """Atomically replace the file: hardstate + snapshot marker +
+        retained entries (absolute indices base+1…)."""
+        tmp = self._path + ".compact"
+        with open(tmp, "wb") as f:
+            v = (self.voted_for or "").encode()
+            f.write(self._frame(bytes([_HARDSTATE])
+                                + struct.pack("<q", self.term)
+                                + struct.pack("<I", len(v)) + v))
+            f.write(self._frame(bytes([_SNAPSHOT])
+                                + struct.pack("<qqqq", snap_index,
+                                              snap_term, base, base_term)
+                                + snap_data))
+            for i, (eterm, data) in enumerate(keep):
+                f.write(self._frame(bytes([_ENTRY])
+                                    + struct.pack("<qq", eterm,
+                                                  base + i + 1)
+                                    + data))
+            f.flush()
+            os.fsync(f.fileno())
+        self._f.close()
+        os.replace(tmp, self._path)
+        self._f = open(self._path, "ab")
+        self.snap_index = snap_index
+        self.snap_term = snap_term
+        self.snap_data = snap_data
+        self.base = base
+        self.base_term = base_term
+        self.entries = keep
+
+    def compact(self, upto: int, term: int, data: bytes,
+                margin: int = 0) -> None:
+        """Record a snapshot at `upto` (which must be applied) and drop
+        entries ≤ upto - margin; the margin stays available for
+        AppendEntries repair of slightly-lagging followers."""
+        if upto <= self.snap_index:
+            return
+        new_base = max(self.base, upto - margin)
+        keep = self.entries[new_base - self.base:]
+        self._rewrite(upto, term, data,
+                      new_base, self.term_at(new_base), keep)
+
+    def install_snapshot(self, index: int, term: int, data: bytes) -> None:
+        """Replace the entire log with a received snapshot."""
+        self._rewrite(index, term, data, index, term, [])
 
     def close(self) -> None:
         self._f.close()
@@ -201,7 +310,10 @@ class RaftNode:
                  apply_cb: Callable[[int, bytes], None],
                  election_timeout: Tuple[float, float] = (0.15, 0.3),
                  heartbeat_s: float = 0.05,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 snapshot_interval: Optional[int] = None,
+                 snapshot_cb: Optional[Callable[[], bytes]] = None,
+                 install_cb: Optional[Callable[[int, bytes], None]] = None):
         self.id = node_id
         self.peers = [p for p in peers if p != node_id]
         self._transport = transport
@@ -210,14 +322,26 @@ class RaftNode:
         self._eto = election_timeout
         self._hb = heartbeat_s
         self._rng = rng or random.Random()
+        # snapshotting (reference: SnapshotIntervalSize, storage.go:299):
+        # every `snapshot_interval` applied entries, snapshot_cb() is
+        # asked for an app-state pointer and the log is compacted up to
+        # last_applied; install_cb(index, data) must restore/catch up
+        # app state when a snapshot arrives from the leader.
+        self._snap_every = snapshot_interval
+        self._snap_margin = (min(self.SNAPSHOT_CATCHUP_ENTRIES,
+                                 snapshot_interval // 2)
+                             if snapshot_interval else 0)
+        self._snapshot_cb = snapshot_cb
+        self._install_cb = install_cb
 
         self.state = FOLLOWER
         self.leader_id: Optional[str] = None
-        self.commit_index = 0
-        self.last_applied = 0
+        self.commit_index = self._wal.snap_index
+        self.last_applied = self._wal.snap_index
         self._votes: set = set()
         self._next_index: Dict[str, int] = {}
         self._match_index: Dict[str, int] = {}
+        self._snap_sent: Dict[str, float] = {}
         self._q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._deadline = 0.0
@@ -246,10 +370,10 @@ class RaftNode:
 
     @property
     def last_index(self) -> int:
-        return len(self._wal.entries)
+        return self._wal.last_index
 
     def _last_term(self) -> int:
-        return self._wal.entries[-1][0] if self._wal.entries else 0
+        return self._wal.term_at(self._wal.last_index)
 
     # -- FSM loop (reference: chain.go:533 run) ---------------------------
     def _run(self) -> None:
@@ -337,14 +461,25 @@ class RaftNode:
 
     def _send_append(self, peer: str) -> None:
         nxt = self._next_index.get(peer, self.last_index + 1)
+        if nxt <= self._wal.base:
+            # the entries the follower needs were compacted: ship the
+            # snapshot instead (reference: chain.go:880 catchUp).
+            # Installation triggers an app-level block fetch, so do
+            # not hammer a slow installer on every heartbeat.
+            now = time.monotonic()
+            if now - self._snap_sent.get(peer, 0.0) >= 10 * self._hb:
+                self._snap_sent[peer] = now
+                self._transport.send(self.id, peer, InstallSnapshot(
+                    self._wal.term, self.id, self._wal.snap_index,
+                    self._wal.snap_term, self._wal.snap_data))
+            return
         prev_index = nxt - 1
-        prev_term = (self._wal.entries[prev_index - 1][0]
-                     if prev_index >= 1 and
-                     prev_index <= len(self._wal.entries) else 0)
+        prev_term = (self._wal.term_at(prev_index)
+                     if (self._wal.base <= prev_index
+                         <= self._wal.last_index) else 0)
         # cap the suffix: a lagging follower is repaired in bounded
         # chunks instead of O(K^2) full-suffix resends per heartbeat
-        entries = self._wal.entries[nxt - 1:
-                                    nxt - 1 + self.MAX_ENTRIES_PER_APPEND]
+        entries = self._wal.entries_from(nxt, self.MAX_ENTRIES_PER_APPEND)
         self._transport.send(self.id, peer, AppendEntries(
             self._wal.term, self.id, prev_index, prev_term,
             list(entries), self.commit_index))
@@ -359,6 +494,8 @@ class RaftNode:
             self._on_append(msg)
         elif isinstance(msg, AppendReply):
             self._on_append_reply(msg)
+        elif isinstance(msg, InstallSnapshot):
+            self._on_install_snapshot(msg)
 
     def _on_request_vote(self, msg: RequestVote) -> None:
         if msg.term > self._wal.term:
@@ -395,20 +532,30 @@ class RaftNode:
             return
         self.leader_id = msg.leader
         self._reset_election_timer()
-        # log matching check
-        if msg.prev_index > 0:
-            if msg.prev_index > self.last_index or \
-                    self._wal.entries[msg.prev_index - 1][0] != \
-                    msg.prev_term:
+        # log matching check (indices ≤ snap_index are committed by
+        # definition — the snapshot only ever covers applied entries —
+        # so matching is checked from max(prev, snap_index) up)
+        snap = self._wal.snap_index
+        if msg.prev_index > self.last_index:
+            # reply our last index as a repair hint so the leader jumps
+            # straight there instead of decrementing one per round-trip
+            self._transport.send(self.id, msg.leader, AppendReply(
+                self._wal.term, self.id, False, self.last_index))
+            return
+        if msg.prev_index > snap and msg.prev_index > 0:
+            if self._wal.term_at(msg.prev_index) != msg.prev_term:
                 self._transport.send(self.id, msg.leader, AppendReply(
-                    self._wal.term, self.id, False, 0))
+                    self._wal.term, self.id, False, msg.prev_index - 1))
                 return
-        # append (truncating conflicts)
+        # append (truncating conflicts; entries folded into our
+        # snapshot are skipped — they are already applied state)
         idx = msg.prev_index
         for eterm, data in msg.entries:
             idx += 1
+            if idx <= snap:
+                continue
             if idx <= self.last_index:
-                if self._wal.entries[idx - 1][0] == eterm:
+                if self._wal.term_at(idx) == eterm:
                     continue               # already have it
             self._wal.append(idx, eterm, data)
         if msg.leader_commit > self.commit_index:
@@ -435,17 +582,19 @@ class RaftNode:
                 self._match_index[msg.follower] + 1
             self._advance_commit()
         else:
-            # repair: back off one step and retry (§5.3)
+            # repair: back off, jumping straight to the follower's
+            # hinted last index when it is further behind (§5.3)
+            cur = self._next_index.get(msg.follower, self.last_index + 1)
             self._next_index[msg.follower] = max(
-                1, self._next_index.get(msg.follower,
-                                        self.last_index + 1) - 1)
+                1, min(cur - 1, msg.match_index + 1))
             self._send_append(msg.follower)
 
     def _advance_commit(self) -> None:
         """Commit the highest index replicated on a majority whose
         entry is from the CURRENT term (§5.4.2)."""
-        for n in range(self.last_index, self.commit_index, -1):
-            if self._wal.entries[n - 1][0] != self._wal.term:
+        for n in range(self.last_index,
+                       max(self.commit_index, self._wal.snap_index), -1):
+            if self._wal.term_at(n) != self._wal.term:
                 break
             count = 1 + sum(1 for p in self.peers
                             if self._match_index.get(p, 0) >= n)
@@ -458,7 +607,7 @@ class RaftNode:
     def _apply_committed(self) -> None:
         while self.last_applied < self.commit_index:
             nxt = self.last_applied + 1
-            term, data = self._wal.entries[nxt - 1]
+            term, data = self._wal.entry(nxt)
             if data:                       # skip no-op barrier entries
                 try:
                     self._apply(nxt, data)
@@ -468,3 +617,61 @@ class RaftNode:
                     # chain; stop and retry on the next commit signal
                     return
             self.last_applied = nxt
+        self._maybe_compact()
+
+    # entries retained BEHIND the snapshot point so a follower that
+    # missed only a few messages is repaired by plain AppendEntries
+    # instead of the full snapshot+fetch path (reference: etcd's
+    # SnapshotCatchUpEntries)
+    SNAPSHOT_CATCHUP_ENTRIES = 16
+
+    def _maybe_compact(self) -> None:
+        """Fold applied entries into a snapshot every `snapshot_interval`
+        applies (reference: storage.go:299 TakeSnapshot + gc)."""
+        if not self._snap_every or self._snapshot_cb is None:
+            return
+        if self.last_applied - self._wal.snap_index < self._snap_every:
+            return
+        try:
+            data = self._snapshot_cb()
+        except Exception:
+            return                         # keep the log; retry later
+        self._wal.compact(self.last_applied,
+                          self._wal.term_at(self.last_applied), data,
+                          margin=self._snap_margin)
+
+    def _on_install_snapshot(self, msg: InstallSnapshot) -> None:
+        if msg.term > self._wal.term:
+            self._step_down(msg.term)
+        if msg.term < self._wal.term:
+            self._transport.send(self.id, msg.leader, AppendReply(
+                self._wal.term, self.id, False, 0))
+            return
+        if self.state != FOLLOWER:
+            self._step_down(msg.term)
+        self.leader_id = msg.leader
+        self._reset_election_timer()
+        if msg.last_index <= self.commit_index:
+            # nothing to install; tell the leader where we really are
+            # so it resumes AppendEntries from there
+            self._transport.send(self.id, msg.leader, AppendReply(
+                self._wal.term, self.id, True, self.commit_index))
+            return
+        # the app must be able to reconstruct state up to last_index
+        # (for the orderer: pull the missing blocks); refuse otherwise —
+        # accepting would silently skip committed entries
+        if self._install_cb is None:
+            self._transport.send(self.id, msg.leader, AppendReply(
+                self._wal.term, self.id, False, self.commit_index))
+            return
+        try:
+            self._install_cb(msg.last_index, msg.data)
+        except Exception:
+            self._transport.send(self.id, msg.leader, AppendReply(
+                self._wal.term, self.id, False, self.commit_index))
+            return
+        self._wal.install_snapshot(msg.last_index, msg.last_term, msg.data)
+        self.commit_index = msg.last_index
+        self.last_applied = msg.last_index
+        self._transport.send(self.id, msg.leader, AppendReply(
+            self._wal.term, self.id, True, msg.last_index))

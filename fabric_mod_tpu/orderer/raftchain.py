@@ -23,6 +23,7 @@ from typing import List, Optional, Tuple
 from fabric_mod_tpu.orderer.consensus import ChainHaltedError
 from fabric_mod_tpu.orderer.raft import RaftNode, RaftTransport
 from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
 
 _NORMAL, _CONFIG = 0, 1
 
@@ -58,12 +59,27 @@ class RaftChain:
 
     def __init__(self, node_id: str, peer_ids: List[str],
                  transport: RaftTransport, wal_path: str, support,
-                 election_timeout=(0.15, 0.3), heartbeat_s=0.05):
+                 election_timeout=(0.15, 0.3), heartbeat_s=0.05,
+                 snapshot_interval: Optional[int] = None,
+                 block_fetcher=None):
+        """`snapshot_interval`: compact the raft log every N applied
+        entries (reference: SnapshotIntervalSize).  `block_fetcher`:
+        callable(from_height, to_height) -> list[Block] used by a
+        lagging node to pull blocks it can no longer rebuild from
+        compacted entries (reference: the cluster block puller,
+        orderer/common/cluster/deliver.go:571).  The fetcher runs on
+        the raft FSM thread, so it MUST bound its own time (connect +
+        read deadlines); raising is safe — the leader re-offers the
+        snapshot with backoff."""
         self.node_id = node_id
         self._support = support
         self._transport = transport
+        self._fetch_blocks = block_fetcher
         self._raft = RaftNode(node_id, peer_ids, transport, wal_path,
-                              self._apply, election_timeout, heartbeat_s)
+                              self._apply, election_timeout, heartbeat_s,
+                              snapshot_interval=snapshot_interval,
+                              snapshot_cb=self._snapshot_state,
+                              install_cb=self._install_snapshot)
         transport.register(f"{node_id}:chain", self._on_chain_msg)
         self._q: "queue.Queue[Optional[_Submit]]" = queue.Queue(10_000)
         self._halted = threading.Event()
@@ -74,15 +90,7 @@ class RaftChain:
         # restart would re-append the whole chain at new heights —
         # reference: etcdraft's lastBlock/appliedIndex in the
         # consenter metadata).
-        self._applied_upto = 0
-        h = support.store.height
-        if h > 1:
-            tip = support.store.get_block_by_number(h - 1)
-            md = tip.metadata.metadata if tip.metadata else []
-            if len(md) > self.RAFT_INDEX_MD_SLOT and \
-                    md[self.RAFT_INDEX_MD_SLOT]:
-                self._applied_upto = int.from_bytes(
-                    md[self.RAFT_INDEX_MD_SLOT], "big")
+        self._applied_upto = self._tip_raft_index(support.store)
 
     # -- consenter surface ------------------------------------------------
     def start(self) -> None:
@@ -212,6 +220,85 @@ class RaftChain:
                 batch = support.cutter.cut()
                 if batch:
                     self._propose_batch(batch, _NORMAL, 0)
+
+    # -- snapshots (reference: etcdraft snapshot catch-up) ----------------
+    def _snapshot_state(self) -> bytes:
+        """The app-state pointer carried by a raft snapshot: our block
+        height.  The ledger IS the state (SURVEY §5.4) — a snapshot
+        need only say how tall the chain is; a lagging node fetches
+        the actual blocks."""
+        return self._support.store.height.to_bytes(8, "big")
+
+    def _install_snapshot(self, index: int, data: bytes) -> None:
+        """Catch this node's chain up to the snapshot's height by
+        pulling real blocks (reference: chain.go:880 catchUp via the
+        cluster puller).  Raises when catch-up is impossible, which
+        makes the raft layer refuse the snapshot."""
+        target = int.from_bytes(data[:8], "big")
+        support = self._support
+        h = support.store.height
+        if h < target:
+            if self._fetch_blocks is None:
+                raise RuntimeError("snapshot needs %d..%d but no block "
+                                   "fetcher is configured" % (h, target))
+            blocks = self._fetch_blocks(h, target)
+            for block in blocks:
+                self._append_fetched(block)
+        if support.store.height < target:
+            raise RuntimeError("catch-up fetched too few blocks")
+        # trust the raft index recorded in the fetched tip block (it
+        # equals the snapshot index, but the block metadata is the
+        # authoritative record) so WAL-replayed entries covering the
+        # fetched blocks are skipped, not re-appended
+        self._applied_upto = max(self._applied_upto, index,
+                                 self._tip_raft_index(support.store))
+
+    def _append_fetched(self, block: m.Block) -> None:
+        """Append one pulled block, verifying the hash chain AND the
+        orderer block signature against the channel's BlockValidation
+        policy (reference: cluster.VerifyBlocks in the replication
+        puller) — the fetch source is untrusted; config blocks go
+        through process_config so the bundle follows."""
+        from fabric_mod_tpu.peer.mcs import MessageCryptoService
+        support = self._support
+        store = support.store
+        if block.header.number != store.height:
+            raise RuntimeError("fetched block out of order")
+        if store.height and \
+                block.header.previous_hash != store.last_block_hash:
+            raise RuntimeError("fetched block breaks the hash chain")
+        MessageCryptoService(support.bundle).verify_block(
+            support.channel_id, block)
+        if self._is_config_block(block):
+            envs = protoutil.get_envelopes(block)
+            support.process_config(envs[0], block)
+        else:
+            support.writer.write_block(block)
+
+    @classmethod
+    def _tip_raft_index(cls, store) -> int:
+        """Raft index recorded in the tip block's metadata (0 when the
+        chain has no raft-written block yet)."""
+        h = store.height
+        if h > 1:
+            tip = store.get_block_by_number(h - 1)
+            md = tip.metadata.metadata if tip.metadata else []
+            if len(md) > cls.RAFT_INDEX_MD_SLOT and \
+                    md[cls.RAFT_INDEX_MD_SLOT]:
+                return int.from_bytes(md[cls.RAFT_INDEX_MD_SLOT], "big")
+        return 0
+
+    @staticmethod
+    def _is_config_block(block: m.Block) -> bool:
+        try:
+            envs = protoutil.get_envelopes(block)
+            if len(envs) != 1:
+                return False
+            payload = protoutil.unmarshal_envelope_payload(envs[0])
+            ch = m.ChannelHeader.decode(payload.header.channel_header)
+            return ch.type == m.HeaderType.CONFIG
+        except Exception:
+            return False
 
     # -- apply (every node, in commit order) ------------------------------
     def _apply(self, index: int, data: bytes) -> None:

@@ -14,6 +14,7 @@ import hashlib
 import io
 import json
 import os
+import re
 import tarfile
 from typing import List, Optional, Tuple
 
@@ -64,19 +65,14 @@ def parse_package(raw: bytes) -> Tuple[str, str, bytes]:
     return label, meta.get("type", ""), code
 
 
-_LABEL_RE = None
+_LABEL_RE = re.compile(r"^[a-zA-Z0-9]+([.+\-_][a-zA-Z0-9]+)*$")
 
 
 def _label_ok(label: str) -> bool:
     """One label rule shared by parse and the store's id guard — the
     reference's regex: alnum runs joined by single . + - _ separators
     (no edge or consecutive separators)."""
-    global _LABEL_RE
-    if _LABEL_RE is None:
-        import re
-        _LABEL_RE = re.compile(
-            r"^[a-zA-Z0-9]+([.+\-_][a-zA-Z0-9]+)*$")
-    return bool(_LABEL_RE.match(label))
+    return bool(_LABEL_RE.fullmatch(label))
 
 
 def package_id(label: str, raw: bytes) -> str:

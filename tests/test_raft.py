@@ -328,3 +328,218 @@ def test_raft_cluster_survives_leader_kill(cluster):
             supports[i].store.get_block_by_number(num).header)
             for i in live}
         assert len(hashes) == 1
+
+
+# --- snapshots + log compaction ---------------------------------------------
+
+def test_compaction_bounds_wal_and_survives_restart(tmp_path):
+    """snapshot_interval folds applied entries into a snapshot marker:
+    the in-memory log and the WAL file stay bounded, and a restart
+    resumes from the snapshot without re-applying compacted entries."""
+    transport = RaftTransport()
+    applied = []
+    node = RaftNode("solo", ["solo"], transport,
+                    str(tmp_path / "solo.wal"),
+                    lambda idx, data: applied.append((idx, data)),
+                    snapshot_interval=10,
+                    snapshot_cb=lambda: b"height-marker")
+    node.start()
+    try:
+        assert _wait(lambda: node.state == "leader", timeout=10.0)
+        for i in range(37):
+            node.propose(b"e%02d" % i)
+        assert _wait(lambda: len(applied) == 37, timeout=10.0)
+        assert _wait(lambda: node._wal.snap_index >= 30, timeout=5.0)
+        # log is bounded: only the un-compacted suffix is retained
+        assert len(node._wal.entries) < 15
+        size_before = os.path.getsize(str(tmp_path / "solo.wal"))
+    finally:
+        node.stop()
+    # a pile of new entries after compaction must not regrow past the
+    # snapshot-interval watermark (the file is rewritten each fold)
+    applied2 = []
+    node2 = RaftNode("solo", ["solo"], transport,
+                     str(tmp_path / "solo.wal"),
+                     lambda idx, data: applied2.append((idx, data)),
+                     snapshot_interval=10,
+                     snapshot_cb=lambda: b"height-marker")
+    assert node2._wal.snap_index >= 30
+    assert node2._wal.snap_data == b"height-marker"
+    assert node2.last_applied == node2._wal.snap_index
+    node2.start()
+    try:
+        assert _wait(lambda: node2.state == "leader", timeout=10.0)
+        node2.propose(b"after")
+        assert _wait(lambda: any(d == b"after" for _, d in applied2),
+                     timeout=10.0)
+        # compacted entries were NOT re-applied on restart
+        assert all(idx > 30 for idx, _ in applied2)
+    finally:
+        node2.stop()
+    assert size_before < 4096
+
+
+def test_install_snapshot_catches_up_lagging_follower(tmp_path):
+    """A follower partitioned long enough that the leader compacted
+    the entries it needs must be caught up via InstallSnapshot + the
+    app-level install callback (reference: chain.go:880 catchUp)."""
+    import json
+
+    transport = RaftTransport()
+    ids = ["a", "b", "c"]
+    applied = {i: [] for i in ids}
+    installs = {i: [] for i in ids}
+    nodes = {}
+
+    def make(i):
+        def snap_cb(i=i):
+            return json.dumps(
+                [[idx, d.decode()] for idx, d in applied[i]]).encode()
+
+        def install_cb(index, data, i=i):
+            installs[i].append(index)
+            applied[i][:] = [(idx, d.encode())
+                             for idx, d in json.loads(data.decode())]
+
+        return RaftNode(
+            i, ids, transport, str(tmp_path / f"{i}.wal"),
+            lambda idx, data, i=i: applied[i].append((idx, data)),
+            snapshot_interval=8, snapshot_cb=snap_cb,
+            install_cb=install_cb)
+
+    for i in ids:
+        nodes[i] = make(i)
+        nodes[i].start()
+    try:
+        leader = _leader(nodes)
+        follower = [i for i in ids if i != leader.id][0]
+        for i in range(3):
+            leader.propose(b"pre%d" % i)
+        assert _wait(lambda: all(len(applied[i]) == 3 for i in ids))
+        # cut the follower off and push the leader far past the
+        # compaction watermark
+        transport.partitioned.add(follower)
+        for i in range(30):
+            leader.propose(b"mid%02d" % i)
+        live = [i for i in ids if i != follower]
+        assert _wait(lambda: all(len(applied[i]) == 33 for i in live),
+                     timeout=15.0)
+        assert _wait(lambda: leader._wal.snap_index > 10, timeout=10.0)
+        # heal: the follower needs compacted entries -> snapshot path
+        transport.partitioned.clear()
+        assert _wait(lambda: [d for _, d in applied[follower]] ==
+                     [d for _, d in applied[leader.id]], timeout=20.0)
+        assert installs[follower], "follower never received a snapshot"
+        assert nodes[follower]._wal.snap_index >= 11
+        # and it keeps replicating normally afterwards
+        leader2 = _leader(nodes)
+        leader2.propose(b"post")
+        assert _wait(lambda: applied[follower] and
+                     applied[follower][-1][1] == b"post", timeout=15.0)
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_raft_chain_snapshot_catchup_pulls_blocks(cluster, tmp_path):
+    """Orderer-level: a follower that missed compacted batches pulls
+    the real blocks through the block_fetcher seam and lands on the
+    identical chain (reference: cluster puller deliver.go:571)."""
+    from fabric_mod_tpu.bccsp.sw import SwCSP
+    from fabric_mod_tpu.channelconfig import genesis
+    from fabric_mod_tpu.msp import ca as calib
+    from fabric_mod_tpu.msp.identities import SigningIdentity
+    from fabric_mod_tpu.orderer.registrar import Registrar
+
+    csp = SwCSP()
+    org_ca = calib.CA("ca.org1", "Org1")
+    ord_ca = calib.CA("ca.orderer", "OrdererOrg")
+    blk = genesis.standard_network(
+        "snapchan", {"Org1": [calib.cert_pem(org_ca.cert)]},
+        {"OrdererOrg": [calib.cert_pem(ord_ca.cert)]},
+        consensus_type="etcdraft", batch_timeout="150ms",
+        max_message_count=2)
+
+    transport = RaftTransport()
+    ids = ["s0", "s1", "s2"]
+    registrars = {}
+
+    def fetcher_for(my_id):
+        def fetch(lo, hi, my_id=my_id):
+            for other in ids:
+                if other == my_id or other not in registrars:
+                    continue
+                store = registrars[other].get_chain("snapchan").store
+                if store.height >= hi:
+                    return [store.get_block_by_number(n)
+                            for n in range(lo, hi)]
+            raise RuntimeError("no peer has blocks %d..%d" % (lo, hi))
+        return fetch
+
+    for i in ids:
+        ocert, okey = ord_ca.issue(f"{i}.orderer", "OrdererOrg",
+                                   ous=["orderer"])
+        signer = SigningIdentity("OrdererOrg", ocert,
+                                 calib.key_pem(okey), csp)
+
+        def factory(support, i=i):
+            return RaftChain(i, ids, transport,
+                             str(tmp_path / f"snap_{i}.wal"), support,
+                             snapshot_interval=4,
+                             block_fetcher=fetcher_for(i))
+        reg = Registrar(str(tmp_path / ("snap_" + i)), signer, csp,
+                        chain_factory=factory)
+        reg.create_channel(blk)
+        registrars[i] = reg
+    world = {"csp": csp, "org_ca": org_ca,
+             "supports": {i: registrars[i].get_chain("snapchan")
+                          for i in ids}}
+    supports = world["supports"]
+    chains = {i: s.chain for i, s in supports.items()}
+    try:
+        assert _wait(lambda: any(c.is_leader for c in chains.values()),
+                     timeout=15.0)
+        leader_id = next(i for i, c in chains.items() if c.is_leader)
+
+        def env(k):
+            from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder
+            if "client" not in world:
+                ccert, ckey = org_ca.issue("client@org1", "Org1",
+                                           ous=["client"])
+                world["client"] = SigningIdentity(
+                    "Org1", ccert, calib.key_pem(ckey), csp)
+            b = RWSetBuilder()
+            b.add_write("cc", f"k{k}", b"v")
+            return protoutil.create_signed_tx(
+                "snapchan", "cc", b.build().encode(), world["client"],
+                [world["client"]])
+
+        for k in range(6):
+            supports[leader_id].chain.order(env(k), 0)
+        assert _wait(lambda: all(s.store.height >= 4
+                                 for s in supports.values()),
+                     timeout=20.0)
+        # partition a follower; drive the leader well past compaction
+        victim = next(i for i, c in chains.items() if not c.is_leader)
+        transport.partitioned.update({victim, f"{victim}:chain"})
+        for k in range(6, 30):
+            supports[leader_id].chain.order(env(k), 0)
+        live = [i for i in ids if i != victim]
+        assert _wait(lambda: all(supports[i].store.height >= 13
+                                 for i in live), timeout=30.0)
+        assert _wait(
+            lambda: chains[leader_id]._raft._wal.snap_index > 0,
+            timeout=15.0)
+        # heal -> snapshot install -> block pull -> identical chains
+        transport.partitioned.clear()
+        assert _wait(lambda: supports[victim].store.height ==
+                     supports[leader_id].store.height, timeout=30.0)
+        h = supports[leader_id].store.height
+        for num in range(1, h):
+            hashes = {protoutil.block_header_hash(
+                s.store.get_block_by_number(num).header)
+                for s in supports.values()}
+            assert len(hashes) == 1, f"divergence at block {num}"
+    finally:
+        for reg in registrars.values():
+            reg.close()
