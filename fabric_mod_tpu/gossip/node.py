@@ -125,6 +125,10 @@ class GossipNode:
             self._handle_update(msg)
         elif msg.private_data is not None:
             self._handle_private(msg)
+        elif msg.pvt_req is not None:
+            self._handle_pvt_request(src_pki_id, msg)
+        elif msg.pvt_resp is not None:
+            self._handle_pvt_response(msg)
 
     def _verify_with_carried_identity(self, env, payload, sig) -> bool:
         """Bootstrap: an alive message carries its own identity —
@@ -237,6 +241,90 @@ class GossipNode:
                 return False
             return pol.satisfied_by_principals([ident])
         return eligible
+
+    # -- private data reconciliation (reference: gossip/privdata/
+    # -- reconcile.go:339 + pull.go:727) ---------------------------------
+    def reconcile_tick(self) -> int:
+        """Ask a few random alive peers for private write-sets this
+        peer committed hashes for but never received the plaintext of.
+        Returns the number of digests requested."""
+        ledger = self._channel.ledger
+        if not hasattr(ledger, "missing_pvt"):
+            return 0
+        missing = ledger.missing_pvt()
+        if not missing:
+            return 0
+        digests = [m.PvtDataDigest(block_num=bn, tx_num=tn,
+                                   namespace=ns, collection=coll)
+                   for bn, tn, ns, coll in missing]
+        req = m.GossipMessage(
+            nonce=self._rng.getrandbits(63),
+            channel=self._channel.channel_id.encode(),
+            pvt_req=m.PvtDataRequest(nonce=self._rng.getrandbits(63),
+                                     digests=digests))
+        peers = self._pick_peers(3)
+        if not peers:
+            return 0
+        self.comm.broadcast(peers, req)
+        return len(digests)
+
+    def _handle_pvt_request(self, src: bytes, msg: m.GossipMessage) -> None:
+        """Serve missing-data requests — but ONLY to requesters whose
+        identity satisfies the collection's member_orgs_policy (same
+        fail-closed gate as dissemination; an ineligible peer learns
+        nothing, not even emptiness vs refusal)."""
+        if msg.channel != self._channel.channel_id.encode():
+            return
+        src_ep = self._members_by_pki.get(src)
+        ident = self.mapper.get(src)
+        if src_ep is None or ident is None:
+            return
+        ledger = self._channel.ledger
+        if not hasattr(ledger, "get_pvt"):
+            return
+        eligible_cache: Dict = {}
+        elements = []
+        for dig in msg.pvt_req.digests:
+            key = (dig.namespace, dig.collection)
+            if key not in eligible_cache:
+                pol = self._channel.collection_policy(*key)
+                if pol is None:
+                    eligible_cache[key] = lambda _b: False
+                else:
+                    eligible_cache[key] = self.eligibility_by_policy(pol)
+            if not eligible_cache[key](ident):
+                continue
+            for ns, coll, kv in ledger.get_pvt(dig.block_num, dig.tx_num):
+                if ns == dig.namespace and coll == dig.collection:
+                    elements.append(m.PvtDataResponseElement(
+                        digest=dig, rwset=kv.encode()))
+        if not elements:
+            return
+        self.comm.send(src_ep, m.GossipMessage(
+            nonce=self._rng.getrandbits(63),
+            channel=self._channel.channel_id.encode(),
+            pvt_resp=m.PvtDataResponse(nonce=msg.pvt_req.nonce,
+                                       elements=elements)))
+
+    def _handle_pvt_response(self, msg: m.GossipMessage) -> None:
+        """Backfill returned write-sets; the ledger re-verifies each
+        against the committed block's hashes, so a forged response is
+        rejected there, not trusted here."""
+        if msg.channel != self._channel.channel_id.encode():
+            return
+        ledger = self._channel.ledger
+        if not hasattr(ledger, "reconcile_pvt"):
+            return
+        for el in msg.pvt_resp.elements:
+            if el.digest is None or not el.rwset:
+                continue
+            try:
+                kv = m.KVRWSet.decode(el.rwset)
+            except Exception:
+                continue
+            ledger.reconcile_pvt(el.digest.block_num, el.digest.tx_num,
+                                 el.digest.namespace,
+                                 el.digest.collection, kv)
 
     # -- pull engine (reference: algo/pull.go) ----------------------------
     def pull_tick(self) -> None:

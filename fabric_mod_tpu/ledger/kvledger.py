@@ -381,7 +381,10 @@ class KvLedger:
             for (ns, coll), hset in hashed.items():
                 kv = self._find_matching_pvt(candidates, ns, coll, hset)
                 if kv is None:
-                    continue               # missing: reconcile later
+                    # missing: record the digest so the reconciler can
+                    # pull it from an eligible peer later
+                    self._pvtstore.report_missing(num, tx_num, ns, coll)
+                    continue
                 for w in kv.writes:
                     pns = pvt_namespace(ns, coll)
                     if w.is_delete:
@@ -414,6 +417,100 @@ class KvLedger:
         if len(purge_batch):
             self.state.apply_updates(purge_batch, num)
         self._pvtstore.purge(num)
+
+    # -- reconciliation (reference: gossip/privdata/reconcile.go:339) ----
+    def get_pvt(self, block_num: int, tx_num: int):
+        """Committed plaintext private write-sets for one tx:
+        [(ns, collection, KVRWSet)] — the public surface reconciliation
+        responders serve from."""
+        if self._pvtstore is None:
+            return []
+        return self._pvtstore.get(block_num, tx_num)
+
+    def missing_pvt(self, limit: int = 50):
+        """Unreconciled (block, tx, ns, collection) digests, dropping
+        any whose BTL already lapsed (no longer needed or wanted)."""
+        if self._pvtstore is None:
+            return []
+        out = []
+        for bn, tn, ns, coll in self._pvtstore.missing(limit):
+            if self._pvt_expired(bn, ns, coll):
+                self._pvtstore.drop_missing(bn, tn, ns, coll)
+                continue
+            out.append((bn, tn, ns, coll))
+        return out
+
+    def _pvt_expired(self, block_num: int, ns: str, coll: str) -> bool:
+        """BTL lapse check aligned with the purge schedule: data from
+        `block_num` is purged while committing block block_num+btl+1,
+        i.e. it is dead once height ≥ block_num+btl+2 — before that,
+        eligible peers still serve it and backfills are welcome."""
+        btl = self._btl_fn(ns, coll)
+        return btl > 0 and block_num + btl + 2 <= self.height
+
+    def reconcile_pvt(self, block_num: int, tx_num: int, ns: str,
+                      coll: str, kv: m.KVRWSet) -> bool:
+        """Backfill a previously-missing private write-set obtained
+        from a peer: re-verify it against the hashes the committed
+        block carries, then apply writes version-aware (a key already
+        rewritten by a LATER block keeps the newer value).  Returns
+        True when the digest was resolved."""
+        from fabric_mod_tpu.ledger.pvtdata import (
+            PvtDataMismatchError, pvt_namespace, verify_pvt_against_hashes)
+        with self._lock:
+            if self._pvtstore is None or \
+                    not self._pvtstore.is_missing(block_num, tx_num,
+                                                  ns, coll):
+                return False
+            if self._pvt_expired(block_num, ns, coll):
+                self._pvtstore.drop_missing(block_num, tx_num, ns, coll)
+                return False               # expired while missing
+            block = self.blockstore.get_block_by_number(block_num)
+            if block is None:
+                return False
+            flags = protoutil.block_txflags(block)
+            envs = protoutil.get_envelopes(block)
+            if tx_num >= len(envs) or \
+                    flags[tx_num] != m.TxValidationCode.VALID:
+                self._pvtstore.drop_missing(block_num, tx_num, ns, coll)
+                return False
+            rwset = tx_rwset_from_envelope(envs[tx_num])
+            hset = None
+            if rwset is not None:
+                for ns_entry in rwset.ns_rwset:
+                    if ns_entry.namespace != ns:
+                        continue
+                    for ch in ns_entry.collection_hashed_rwset:
+                        if ch.collection_name == coll:
+                            hset = m.HashedRWSet.decode(ch.hashed_rwset)
+            if hset is None:
+                self._pvtstore.drop_missing(block_num, tx_num, ns, coll)
+                return False               # block never hashed this coll
+            try:
+                verify_pvt_against_hashes(hset, kv)
+            except PvtDataMismatchError:
+                return False               # forged response; keep waiting
+            batch = UpdateBatch()
+            pns = pvt_namespace(ns, coll)
+            later_keys = self._pvtstore.later_written_keys(
+                block_num, tx_num, ns, coll)
+            for w in kv.writes:
+                cur = self.state.get_version(pns, w.key)
+                if cur is not None and cur >= (block_num, tx_num):
+                    continue               # a later tx already wrote it
+                if w.key in later_keys:
+                    continue               # later delete left no version
+                if w.is_delete:
+                    batch.delete(pns, w.key, (block_num, tx_num))
+                else:
+                    batch.put(pns, w.key, w.value, (block_num, tx_num))
+            if len(batch):
+                # keep the savepoint where it is: this backfills an old
+                # block, it does not advance commit progress
+                self.state.apply_updates(batch, self.state.savepoint)
+            self._pvtstore.commit(block_num, tx_num, ns, coll, kv,
+                                  self._btl_fn(ns, coll))
+            return True
 
     @staticmethod
     def _find_matching_pvt(candidates, ns, coll, hset):

@@ -106,16 +106,44 @@ class PvtDataStore:
                              List[Tuple[str, str, bytes]]] = {}
         # expiry_block -> [(block, tx, ns, collection, [keys])]
         self._expiries: Dict[int, List] = {}
+        # hashed writes committed WITHOUT plaintext — the reconciler's
+        # work list (reference: pvtdatastorage's missing-data index +
+        # reconcile.go:339)
+        self._missing: set = set()   # (block, tx, ns, collection)
 
     def commit(self, block_num: int, tx_num: int, ns: str,
                collection: str, kv: m.KVRWSet, btl: int) -> None:
         with self._lock:
             self._by_block.setdefault((block_num, tx_num), []).append(
                 (ns, collection, kv.encode()))
+            self._missing.discard((block_num, tx_num, ns, collection))
             if btl > 0:
                 keys = [w.key for w in kv.writes]
                 self._expiries.setdefault(block_num + btl + 1, []).append(
                     (block_num, tx_num, ns, collection, keys))
+
+    # -- missing-data index (reconciler work list) ------------------------
+    def report_missing(self, block_num: int, tx_num: int, ns: str,
+                       collection: str) -> None:
+        with self._lock:
+            self._missing.add((block_num, tx_num, ns, collection))
+
+    def missing(self, limit: int = 50) -> List[Tuple[int, int, str, str]]:
+        """Oldest-first batch of unreconciled digests."""
+        with self._lock:
+            return sorted(self._missing)[:limit]
+
+    def drop_missing(self, block_num: int, tx_num: int, ns: str,
+                     collection: str) -> None:
+        """Give up on a digest (e.g. its BTL lapsed before any peer
+        supplied the data)."""
+        with self._lock:
+            self._missing.discard((block_num, tx_num, ns, collection))
+
+    def is_missing(self, block_num: int, tx_num: int, ns: str,
+                   collection: str) -> bool:
+        with self._lock:
+            return (block_num, tx_num, ns, collection) in self._missing
 
     def get(self, block_num: int, tx_num: int
             ) -> List[Tuple[str, str, m.KVRWSet]]:
@@ -123,6 +151,24 @@ class PvtDataStore:
             return [(ns, coll, m.KVRWSet.decode(raw))
                     for ns, coll, raw in
                     self._by_block.get((block_num, tx_num), [])]
+
+    def later_written_keys(self, block_num: int, tx_num: int, ns: str,
+                           collection: str) -> set:
+        """Keys touched by committed private write-sets NEWER than
+        (block_num, tx_num) in this collection — deletes leave no
+        version in the state DB, so the reconciler must consult this
+        before backfilling old writes (else it would resurrect deleted
+        keys).  One scan serves every key of a backfilled set."""
+        keys: set = set()
+        with self._lock:
+            for (bn, tn), entries in self._by_block.items():
+                if (bn, tn) <= (block_num, tx_num):
+                    continue
+                for n, c, raw in entries:
+                    if n == ns and c == collection:
+                        kv = m.KVRWSet.decode(raw)
+                        keys.update(w.key for w in kv.writes)
+        return keys
 
     def expiring_at(self, block_num: int) -> List:
         """[(block, tx, ns, collection, keys)] whose BTL lapses when

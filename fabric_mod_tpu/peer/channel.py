@@ -67,25 +67,38 @@ class Channel:
                                self._collection_btl)
         self._install_bundle(bundle)
 
-    def _collection_btl(self, ns: str, collection: str) -> int:
-        """BTL from the committed chaincode definition's collection
-        configs (reference: the BTL policy of pvtstatepurgemgmt)."""
+    def _static_collection_config(self, ns: str, collection: str):
+        """The committed StaticCollectionConfig for (chaincode,
+        collection), or None (reference: privdata's collection-config
+        retrieval from the lifecycle definition)."""
         from fabric_mod_tpu.peer.lifecycle import (
             LIFECYCLE_NS, definition_key)
         got = self.ledger.state.get_state(LIFECYCLE_NS,
                                           definition_key(ns))
         if got is None:
-            return 0
+            return None
         try:
             d = m.ChaincodeDefinition.decode(got[0])
             pkg = m.CollectionConfigPackage.decode(d.collections)
         except Exception:
-            return 0
+            return None
         for cc in pkg.config:
             sc = cc.static_collection_config
             if sc is not None and sc.name == collection:
-                return sc.block_to_live
-        return 0
+                return sc
+        return None
+
+    def collection_policy(self, ns: str, collection: str):
+        """member_orgs_policy (SignaturePolicyEnvelope) of a committed
+        collection config, or None."""
+        sc = self._static_collection_config(ns, collection)
+        return sc.member_orgs_policy if sc is not None else None
+
+    def _collection_btl(self, ns: str, collection: str) -> int:
+        """BTL from the committed chaincode definition's collection
+        configs (reference: the BTL policy of pvtstatepurgemgmt)."""
+        sc = self._static_collection_config(ns, collection)
+        return sc.block_to_live if sc is not None else 0
 
     # -- bundle lifecycle -------------------------------------------------
     def _install_bundle(self, bundle: Bundle) -> None:

@@ -903,6 +903,40 @@ class PvtDataElement(Msg):
 
 
 @message
+class PvtDataDigest(Msg):
+    """Identifies one missing private write-set (reference:
+    gossip/protoext + the reconciler's PvtDataDigest)."""
+    FIELDS = ((1, "block_num", "u"), (2, "tx_num", "u"),
+              (3, "namespace", "s"), (4, "collection", "s"))
+    block_num: int = 0
+    tx_num: int = 0
+    namespace: str = ""
+    collection: str = ""
+
+
+@message
+class PvtDataRequest(Msg):
+    FIELDS = ((1, "nonce", "u"), (2, "digests", [("m", "PvtDataDigest")]))
+    nonce: int = 0
+    digests: List["PvtDataDigest"] = _f(default_factory=list)
+
+
+@message
+class PvtDataResponseElement(Msg):
+    FIELDS = ((1, "digest", ("m", "PvtDataDigest")), (2, "rwset", "b"))
+    digest: Optional[PvtDataDigest] = None
+    rwset: bytes = b""          # KVRWSet bytes (plaintext writes)
+
+
+@message
+class PvtDataResponse(Msg):
+    FIELDS = ((1, "nonce", "u"),
+              (2, "elements", [("m", "PvtDataResponseElement")]))
+    nonce: int = 0
+    elements: List[PvtDataResponseElement] = _f(default_factory=list)
+
+
+@message
 class GossipMessage(Msg):
     # oneof payload: alive/data/hello/digest/request/update/private
     FIELDS = ((1, "nonce", "u"), (2, "channel", "b"), (3, "tag", "i"),
@@ -912,7 +946,9 @@ class GossipMessage(Msg):
               (8, "data_dig", ("m", "DataDigest")),
               (9, "data_req", ("m", "DataRequest")),
               (10, "data_update", ("m", "DataUpdate")),
-              (11, "private_data", ("m", "PvtDataElement")))
+              (11, "private_data", ("m", "PvtDataElement")),
+              (12, "pvt_req", ("m", "PvtDataRequest")),
+              (13, "pvt_resp", ("m", "PvtDataResponse")))
     nonce: int = 0
     channel: bytes = b""
     tag: int = 0
@@ -923,6 +959,8 @@ class GossipMessage(Msg):
     data_req: Optional[DataRequest] = None
     data_update: Optional[DataUpdate] = None
     private_data: Optional["PvtDataElement"] = None
+    pvt_req: Optional[PvtDataRequest] = None
+    pvt_resp: Optional[PvtDataResponse] = None
 
 
 @message

@@ -209,3 +209,57 @@ def test_unknown_identity_messages_ignored(world):
     before = len(peers[1].discovery.alive_members())
     peers[1].on_message(pki_id_of(rogue.serialize()), signed.encode())
     assert len(peers[1].discovery.alive_members()) == before
+
+
+def test_pvt_reconciliation_pulls_missing_data(world):
+    """A peer that committed hashes without plaintext reconciles by
+    pulling the write-set from an eligible peer; ineligible peers get
+    nothing (reference: gossip/privdata/reconcile.go:339 + pull.go:727
+    with the AccessFilter gate)."""
+    from fabric_mod_tpu.policy import from_string
+    net, _, peers = world
+    _connect_all(peers)
+    # commit a chaincode definition whose col1 admits Org1+Org2 only
+    pkg = m.CollectionConfigPackage(config=[m.CollectionConfig(
+        static_collection_config=m.StaticCollectionConfig(
+            name="col1",
+            member_orgs_policy=from_string(
+                "OR('Org1.peer', 'Org2.peer')")))])
+    net.invoke([b"commit", b"mycc", b"1.0", b"1", b"", pkg.encode()],
+               chaincode="_lifecycle")
+    txid = net.invoke([b"putpvt", b"col1", b"acct"],
+                      transient={"value": b"reconciled-secret"})
+    blocks = _ordered_blocks(net, 2)
+    # only peer0 (Org1) holds the plaintext at commit time
+    pvt = m.TxPvtReadWriteSet(ns_pvt_rwset=[m.NsPvtReadWriteSet(
+        namespace="mycc",
+        collection_pvt_rwset=[m.CollectionPvtReadWriteSet(
+            collection_name="col1",
+            rwset=m.KVRWSet(writes=[m.KVWrite(
+                key="acct", value=b"reconciled-secret")]).encode())])])
+    peers[0]._channel.transient_store.persist(txid, 0, pvt)
+    for blk in blocks:
+        assert peers[0].state.add_block(blk)
+        peers[0].gossip_block(blk)
+    for p in peers:
+        p.state.drain()
+    # peer0 applied the plaintext; peer1/peer2 committed hashes only
+    qe0 = peers[0]._channel.ledger.new_query_executor()
+    assert qe0.get_private_data("mycc", "col1", "acct") == \
+        b"reconciled-secret"
+    for p in (peers[1], peers[2]):
+        qe = p._channel.ledger.new_query_executor()
+        assert qe.get_private_data("mycc", "col1", "acct") is None
+        assert p._channel.ledger.missing_pvt() != []
+    # eligible Org2 peer reconciles successfully
+    asked = peers[1].reconcile_tick()
+    assert asked >= 1
+    qe1 = peers[1]._channel.ledger.new_query_executor()
+    assert qe1.get_private_data("mycc", "col1", "acct") == \
+        b"reconciled-secret"
+    assert peers[1]._channel.ledger.missing_pvt() == []
+    # ineligible Org3 peer asks too but learns nothing
+    peers[2].reconcile_tick()
+    qe2 = peers[2]._channel.ledger.new_query_executor()
+    assert qe2.get_private_data("mycc", "col1", "acct") is None
+    assert peers[2]._channel.ledger.missing_pvt() != []
