@@ -106,8 +106,10 @@ def run_node(genesis_path: str, crypto_dir: str, orderer_org: str,
     health.register("ledger", lambda: None if ledger.height > 0 else
                     (_ for _ in ()).throw(RuntimeError("empty ledger")))
     host, _, port = peer_cfg.ops_listen_address.partition(":")
+    from fabric_mod_tpu.orderer.participation import ChannelParticipation
     ops = OperationsServer(host or "127.0.0.1", int(port or 0),
-                           default_provider(), health)
+                           default_provider(), health,
+                           participation=ChannelParticipation(registrar))
     ops.start()
     log.info("ops server on %s; channel %s at height %d",
              ops.addr, cid, ledger.height)

@@ -71,6 +71,20 @@ class QueryExecutor:
         got = self._db.get_state(pvt_namespace(ns, collection), key)
         return got[0] if got else None
 
+    def _execute_query_versioned(self, ns: str, query):
+        """Shared rich-query core: ([(key, doc, version)], bookmark)."""
+        from fabric_mod_tpu.ledger import richquery
+        q = richquery.RichQuery.parse(query)
+        rows = self._db.get_state_range(ns, "", "")
+        return richquery.execute(rows, q)
+
+    def execute_query(self, ns: str, query):
+        """Rich JSON-selector query over a namespace (reference:
+        statecouchdb.go:1230 ExecuteQuery).  Returns
+        ([(key, doc)], bookmark)."""
+        matches, bookmark = self._execute_query_versioned(ns, query)
+        return [(k, doc) for k, doc, _ver in matches], bookmark
+
 
 class TxSimulator(QueryExecutor):
     """Records reads/writes into an RWSetBuilder
@@ -109,6 +123,18 @@ class TxSimulator(QueryExecutor):
             else:
                 merged[key] = value
         return iter(sorted(merged.items()))
+
+    def execute_query(self, ns: str, query):
+        """Rich query during simulation: each returned key joins the
+        read set, but — exactly like the reference — the query itself
+        is NOT re-executed at validation (no phantom protection for
+        rich queries; statecouchdb documents the same limitation)."""
+        matches, bookmark = self._execute_query_versioned(ns, query)
+        out = []
+        for key, doc, ver in matches:
+            self._rw.add_read(ns, key, ver)
+            out.append((key, doc))
+        return out, bookmark
 
     def set_state(self, ns: str, key: str, value: bytes) -> None:
         self._writes[(ns, key)] = value

@@ -47,9 +47,19 @@ class HealthRegistry:
 class OperationsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  provider: Optional[MetricsProvider] = None,
-                 health: Optional[HealthRegistry] = None):
+                 health: Optional[HealthRegistry] = None,
+                 participation=None, tls: Optional[dict] = None):
+        """`tls`: {"cert": path, "key": path, "client_ca": path?} —
+        serves HTTPS; with client_ca set, clients must present a cert
+        (the reference's operations TLS + clientAuthRequired,
+        system.go:60-120).  The participation API mutates/destroys
+        channel storage, so expose it off-loopback ONLY behind
+        client-authenticated TLS."""
         self.provider = provider or default_provider()
         self.health = health or HealthRegistry()
+        # orderer-only: the channel participation API rides the ops
+        # listener (reference: restapi.go mounted on the admin server)
+        self.participation = participation
         ops = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -89,6 +99,33 @@ class OperationsServer:
                     from fabric_mod_tpu.observability.diag import (
                         dump_threads)
                     self._send(200, dump_threads().encode())
+                elif self.path.startswith("/participation/"):
+                    self._participation("GET")
+                else:
+                    self._send(404, b"not found")
+
+            def _participation(self, method: str) -> None:
+                if ops.participation is None:
+                    self._send(404, b"not found")
+                    return
+                ln = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(ln) if ln else b""
+                code, payload = ops.participation.handle(
+                    method, self.path, body)
+                self._send(code,
+                           json.dumps(payload).encode()
+                           if payload is not None else b"",
+                           "application/json")
+
+            def do_POST(self):
+                if self.path.startswith("/participation/"):
+                    self._participation("POST")
+                else:
+                    self._send(404, b"not found")
+
+            def do_DELETE(self):
+                if self.path.startswith("/participation/"):
+                    self._participation("DELETE")
                 else:
                     self._send(404, b"not found")
 
@@ -105,6 +142,15 @@ class OperationsServer:
                     self._send(404, b"not found")
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
+        if tls:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls["cert"], tls["key"])
+            if tls.get("client_ca"):
+                ctx.load_verify_locations(tls["client_ca"])
+                ctx.verify_mode = ssl.CERT_REQUIRED
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True)
         self.addr = self._httpd.server_address
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)

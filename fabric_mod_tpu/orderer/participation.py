@@ -1,0 +1,246 @@
+"""Channel participation: join/remove/list channels without a system
+channel, including onboarding from a later config block and follower
+chains for non-members.
+
+(reference: orderer/common/channelparticipation/restapi.go:408 — the
+operator REST API; orderer/common/onboarding/onboarding.go:447 — chain
+replication when joining an existing channel; orderer/consensus/
+follower/chain.go — the chain placeholder that keeps pulling blocks
+until this orderer appears in the consenter set.)
+
+Trust model for onboarding, same as the reference: the operator-
+supplied join block is the anchor.  Replicated blocks are accepted
+only if they hash-chain forward from genesis AND the block at the join
+height hashes to exactly the join block; anything a malicious source
+alters breaks one of the two.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from fabric_mod_tpu.channelconfig.configtx import config_from_block
+from fabric_mod_tpu.orderer.consensus import ChainHaltedError
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+
+
+class ParticipationError(Exception):
+    pass
+
+
+# status values (reference: channelparticipation's ChannelInfo)
+ACTIVE, ONBOARDING, FOLLOWER = "active", "onboarding", "follower"
+
+
+class FollowerChain:
+    """Consenter-shaped placeholder for a channel this orderer stores
+    but does not order: rejects Broadcast, keeps the ledger growing by
+    pulling blocks from cluster peers (reference: follower/chain.go).
+
+    `is_member`/`on_member` are the promotion seam: deployments whose
+    channel config encodes a consenter set wire `is_member` to check
+    it and `on_member` to swap in a real consenter (the reference's
+    follower→member transition).  They are optional — without them a
+    follower stays a follower until the operator removes and rejoins
+    as a member."""
+
+    POLL_INTERVAL_S = 0.2
+
+    def __init__(self, support, block_fetcher,
+                 is_member: Optional[Callable[[], bool]] = None,
+                 on_member: Optional[Callable[[], None]] = None):
+        self._support = support
+        self._fetch = block_fetcher
+        self._is_member = is_member
+        self._on_member = on_member
+        self._halted = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    # -- consenter surface (order/configure refuse) ----------------------
+    def start(self) -> None:
+        self._thread.start()
+
+    def halt(self) -> None:
+        self._halted.set()
+        self._thread.join(timeout=5)
+
+    def wait_ready(self) -> None:
+        raise ChainHaltedError("this orderer is a follower of the "
+                               "channel; it does not accept Broadcast")
+
+    def order(self, env, config_seq) -> None:
+        self.wait_ready()
+
+    def configure(self, env, config_seq) -> None:
+        self.wait_ready()
+
+    # -- the pull loop ----------------------------------------------------
+    def poll_once(self) -> int:
+        """One catch-up attempt; returns blocks appended."""
+        if self._fetch is None:
+            return 0
+        store = self._support.store
+        h = store.height
+        try:
+            blocks = self._fetch(h, 0)     # 0 = "to the source's tip"
+        except Exception:
+            return 0
+        appended = 0
+        for block in blocks or []:
+            if block.header.number != store.height:
+                break
+            if store.height and \
+                    block.header.previous_hash != store.last_block_hash:
+                break                      # broken chain: stop pulling
+            if _is_config_block(block):
+                envs = protoutil.get_envelopes(block)
+                try:
+                    self._support.process_config(envs[0], block)
+                except Exception:
+                    break
+            else:
+                self._support.writer.write_block(block)
+            appended += 1
+        if appended and self._is_member is not None and self._is_member():
+            if self._on_member is not None:
+                cb, self._on_member = self._on_member, None
+                cb()
+        return appended
+
+    def _run(self) -> None:
+        while not self._halted.is_set():
+            self.poll_once()
+            self._halted.wait(self.POLL_INTERVAL_S)
+
+
+def _is_config_block(block: m.Block) -> bool:
+    try:
+        envs = protoutil.get_envelopes(block)
+        if len(envs) != 1:
+            return False
+        payload = protoutil.unmarshal_envelope_payload(envs[0])
+        ch = m.ChannelHeader.decode(payload.header.channel_header)
+        return ch.type == m.HeaderType.CONFIG
+    except Exception:
+        return False
+
+
+def replicate_chain(store, join_block: m.Block, block_fetcher) -> None:
+    """Onboard: pull blocks [height, join_height], verify the WHOLE
+    chain against the join-block anchor, then append (reference:
+    onboarding.go:447 ReplicateChains + cluster replication.go:677).
+    Nothing is written until the anchor check passes — a lying source
+    must not leave poisoned partial state that would block an honest
+    re-join.  Raises ParticipationError when the source lies."""
+    target = join_block.header.number
+    if block_fetcher is None:
+        raise ParticipationError(
+            "joining at height %d needs a block fetcher" % target)
+    start = store.height
+    blocks: List[m.Block] = []
+    while start + len(blocks) <= target:
+        batch = block_fetcher(start + len(blocks), target + 1)
+        if not batch:
+            raise ParticipationError(
+                "replication source has no blocks %d..%d"
+                % (start + len(blocks), target))
+        for block in batch:
+            if block.header.number != start + len(blocks):
+                raise ParticipationError("replicated block out of order")
+            blocks.append(block)
+    # verify before writing: hash-chain continuity + the anchor
+    prev = store.last_block_hash if start else None
+    for block in blocks:
+        if prev is not None and block.header.previous_hash != prev:
+            raise ParticipationError(
+                "replicated block %d breaks the hash chain"
+                % block.header.number)
+        prev = protoutil.block_header_hash(block.header)
+    if prev != protoutil.block_header_hash(join_block.header):
+        raise ParticipationError(
+            "replicated chain does not end at the join block "
+            "(forged history)")
+    for block in blocks:
+        store.add_block(block)
+
+
+class ChannelParticipation:
+    """The operator surface (reference: restapi.go:408).  Wraps a
+    Registrar; `http_routes()` exposes it on the operations server."""
+
+    def __init__(self, registrar, block_fetcher=None):
+        self._registrar = registrar
+        self._fetcher = block_fetcher
+
+    # -- queries ----------------------------------------------------------
+    def list_channels(self) -> List[Dict]:
+        out = []
+        for cid in self._registrar.channel_ids():
+            out.append(self.channel_info(cid))
+        return out
+
+    def channel_info(self, channel_id: str) -> Dict:
+        support = self._registrar.get_chain(channel_id)
+        if support is None:
+            raise ParticipationError(f"unknown channel {channel_id!r}")
+        chain = support.chain
+        status = FOLLOWER if isinstance(chain, FollowerChain) else ACTIVE
+        return {"name": channel_id, "height": support.store.height,
+                "status": status}
+
+    # -- join / remove ----------------------------------------------------
+    def join(self, join_block: m.Block, as_follower: bool = False):
+        """Join from a genesis block (height 0) or onboard from a
+        later config block by replicating the chain first."""
+        cid, _config = config_from_block(join_block)
+        if self._registrar.get_chain(cid) is not None:
+            raise ParticipationError(f"channel {cid!r} exists")
+        if as_follower and self._fetcher is None and \
+                getattr(self._registrar, "_block_fetcher", None) is None:
+            # fail loudly: a fetcher-less follower would sit at the
+            # join height forever with no error anywhere
+            raise ParticipationError(
+                "this node has no replication source configured; "
+                "follower channels cannot pull blocks")
+        return self._registrar.join_channel(
+            join_block, block_fetcher=self._fetcher,
+            as_follower=as_follower)
+
+    def remove(self, channel_id: str) -> None:
+        self._registrar.remove_channel(channel_id)
+
+    # -- HTTP wiring (the REST shape of restapi.go) ----------------------
+    def handle(self, method: str, path: str, body: bytes):
+        """(code, json-serializable) for
+        {GET,POST,DELETE} /participation/v1/channels[/<id>]."""
+        import base64
+        import json as _json
+        parts = [p for p in path.split("/") if p]
+        # parts: ["participation", "v1", "channels", <id>?]
+        if len(parts) < 3 or parts[0] != "participation" or \
+                parts[1] != "v1" or parts[2] != "channels":
+            return 404, {"error": "not found"}
+        cid = parts[3] if len(parts) > 3 else None
+        try:
+            if method == "GET" and cid is None:
+                return 200, {"channels": self.list_channels()}
+            if method == "GET":
+                return 200, self.channel_info(cid)
+            if method == "POST" and cid is None:
+                req = _json.loads(body or b"{}")
+                block = m.Block.decode(
+                    base64.b64decode(req["config_block"]))
+                info = self.join(block,
+                                 as_follower=bool(req.get("follower")))
+                return 201, {"name": info.channel_id,
+                             "height": info.store.height}
+            if method == "DELETE" and cid is not None:
+                self.remove(cid)
+                return 204, None
+        except ParticipationError as e:
+            return 400, {"error": str(e)}
+        except Exception as e:
+            return 400, {"error": f"bad request: {e}"}
+        return 405, {"error": "method not allowed"}

@@ -95,19 +95,31 @@ class Registrar:
     """(reference: multichannel/registrar.go)"""
 
     def __init__(self, root_dir: str, signer, csp, verify_many=None,
-                 chain_factory=None):
+                 chain_factory=None, block_fetcher=None):
+        """`block_fetcher`: callable(lo, hi) -> blocks, the cluster
+        replication source used by follower channels and non-genesis
+        joins (reference: the cluster block puller)."""
         self._root = root_dir
         self._signer = signer
         self._csp = csp
         self._verify_many = verify_many
         self._chain_factory = chain_factory
+        self._block_fetcher = block_fetcher
         self._chains: Dict[str, ChainSupport] = {}
+        # channel ids being joined/removed right now: reserved so a
+        # concurrent join/remove of the same id cannot interleave
+        self._busy: set = set()
         self._lock = threading.Lock()
         os.makedirs(root_dir, exist_ok=True)
-        # Recover existing channels from disk (reference: Initialize)
+        # Recover existing channels from disk (reference: Initialize).
+        # Directories carrying a .joining marker died mid-onboarding:
+        # their chains are incomplete and must NOT come up as active —
+        # a re-join resumes the replication (onboarding.go's restart
+        # stance).
         for name in sorted(os.listdir(root_dir)):
             path = os.path.join(root_dir, name)
-            if os.path.isdir(path):
+            if os.path.isdir(path) and not os.path.exists(
+                    os.path.join(path, ".joining")):
                 self._open_channel(name, path)
 
     def _open_channel(self, channel_id: str, path: str) -> None:
@@ -124,9 +136,18 @@ class Registrar:
             raise RegistrarError(
                 f"directory {channel_id!r} holds channel {cid!r}")
         bundle = Bundle(cid, config, self._csp)
+        # follower channels stay followers across restarts (the
+        # .follower marker) — a non-member must never come back up
+        # ordering (reference: the follower chain registry)
+        factory = self._chain_factory
+        if os.path.exists(os.path.join(path, ".follower")):
+            from fabric_mod_tpu.orderer.participation import FollowerChain
+
+            def factory(support, fetch=self._block_fetcher):
+                return FollowerChain(support, fetch)
         support = ChainSupport(cid, store, bundle, self._signer, self._csp,
                                self._verify_many,
-                               chain_factory=self._chain_factory)
+                               chain_factory=factory)
         self._chains[cid] = support
         support.start()
 
@@ -149,6 +170,92 @@ class Registrar:
             self._chains[cid] = support
         support.start()
         return support
+
+    # -- channel participation (reference: restapi.go:408 join/remove) ---
+    def join_channel(self, join_block: m.Block, block_fetcher=None,
+                     as_follower: bool = False) -> ChainSupport:
+        """Join from a genesis block, or onboard from a later config
+        block by replicating the chain first (anchored to the join
+        block).  `as_follower` stores + follows without ordering
+        (reference: follower/chain.go).  Replication runs OUTSIDE the
+        registrar lock — a slow source must not stall the other
+        channels' get_chain; the id is reserved instead."""
+        import shutil
+        from fabric_mod_tpu.orderer.participation import (
+            FollowerChain, replicate_chain)
+        cid, _config = config_from_block(join_block)
+        fetch = block_fetcher or self._block_fetcher
+        with self._lock:
+            if cid in self._chains or cid in self._busy:
+                raise RegistrarError(f"channel {cid!r} exists or is "
+                                     "being joined/removed")
+            self._busy.add(cid)
+        store = None
+        try:
+            path = os.path.join(self._root, cid)
+            marker = os.path.join(path, ".joining")
+            if os.path.exists(marker):
+                # a previous join died mid-replication: its partial
+                # chain was never anchor-verified — wipe and restart
+                shutil.rmtree(path, ignore_errors=True)
+            os.makedirs(path, exist_ok=True)
+            store = BlockStore(path)
+            if join_block.header.number == 0:
+                if store.height == 0:
+                    store.add_block(join_block)
+            else:
+                with open(marker, "w"):
+                    pass
+                replicate_chain(store, join_block, fetch)
+                os.remove(marker)
+            if as_follower:
+                with open(os.path.join(path, ".follower"), "w"):
+                    pass
+            # bundle from the latest config block now in the store
+            tip = store.get_block_by_number(store.height - 1)
+            lc = last_config_index(tip)
+            cfg_block = store.get_block_by_number(lc or 0)
+            _cid2, config = config_from_block(cfg_block)
+            bundle = Bundle(cid, config, self._csp)
+            if as_follower:
+                def factory(support, f=fetch):
+                    return FollowerChain(support, f)
+            else:
+                factory = self._chain_factory
+            support = ChainSupport(cid, store, bundle, self._signer,
+                                   self._csp, self._verify_many,
+                                   chain_factory=factory)
+            with self._lock:
+                self._chains[cid] = support
+        except Exception:
+            if store is not None:
+                store.close()
+            raise
+        finally:
+            with self._lock:
+                self._busy.discard(cid)
+        support.start()
+        return support
+
+    def remove_channel(self, channel_id: str) -> None:
+        """Halt + delete a channel's chain and storage (reference:
+        restapi.go DELETE /channels/<id> → registrar RemoveChannel).
+        The id stays reserved until the files are gone so a concurrent
+        re-join cannot race the deletion."""
+        import shutil
+        with self._lock:
+            support = self._chains.pop(channel_id, None)
+            if support is None:
+                raise RegistrarError(f"unknown channel {channel_id!r}")
+            self._busy.add(channel_id)
+        try:
+            support.halt()
+            support.store.close()
+            shutil.rmtree(os.path.join(self._root, channel_id),
+                          ignore_errors=True)
+        finally:
+            with self._lock:
+                self._busy.discard(channel_id)
 
     def get_chain(self, channel_id: str) -> Optional[ChainSupport]:
         with self._lock:

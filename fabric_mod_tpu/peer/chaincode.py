@@ -43,6 +43,12 @@ class ChaincodeStub:
     def del_state(self, key: str) -> None:
         self._sim.delete_state(self.namespace, key)
 
+    def get_query_result(self, query):
+        """Rich JSON-selector query (reference: the shim's
+        GetQueryResult; handler.go HandleGetQueryResult).  Returns
+        ([(key, doc)], bookmark)."""
+        return self._sim.execute_query(self.namespace, query)
+
     def get_state_range(self, start: str, end: str):
         return self._sim.get_state_range(self.namespace, start, end)
 
@@ -120,6 +126,15 @@ class KvContract:
             stub.set_state_metadata(stub.args[1].decode(),
                                     "VALIDATION_PARAMETER", stub.args[2])
             return b"ok"
+        if op == "query":
+            # rich query: args[1] = Mango query JSON; returns the
+            # matches as a JSON array of {key, doc} (the marbles
+            # queryMarblesByOwner pattern)
+            import json
+            results, bookmark = stub.get_query_result(stub.args[1])
+            return json.dumps(
+                {"results": [{"key": k, "doc": d} for k, d in results],
+                 "bookmark": bookmark}).encode()
         if op == "putpvt":
             # value arrives via the transient map so it never lands in
             # the ordered tx (reference: the pvt marbles pattern)

@@ -1,0 +1,319 @@
+"""Channel participation: join/list/remove, onboarding replication
+anchored to the join block, follower chains, and the REST surface.
+
+(reference test model: channelparticipation + onboarding unit suites —
+join at genesis, join at a later config block with replication,
+forged-history rejection, follower catch-up, remove.)
+"""
+import base64
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from fabric_mod_tpu.bccsp.sw import SwCSP
+from fabric_mod_tpu.channelconfig import genesis
+from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder
+from fabric_mod_tpu.msp import ca as calib
+from fabric_mod_tpu.msp.identities import SigningIdentity
+from fabric_mod_tpu.orderer.consensus import ChainHaltedError
+from fabric_mod_tpu.orderer.participation import (
+    ChannelParticipation, FollowerChain, ParticipationError)
+from fabric_mod_tpu.orderer.registrar import Registrar, RegistrarError
+from fabric_mod_tpu.protos import protoutil
+
+
+@pytest.fixture()
+def world(tmp_path):
+    csp = SwCSP()
+    org_ca = calib.CA("ca.org1", "Org1")
+    ord_ca = calib.CA("ca.orderer", "OrdererOrg")
+    blk = genesis.standard_network(
+        "partchan", {"Org1": [calib.cert_pem(org_ca.cert)]},
+        {"OrdererOrg": [calib.cert_pem(ord_ca.cert)]},
+        batch_timeout="100ms", max_message_count=3)
+    oc, ok = ord_ca.issue("o1.orderer", "OrdererOrg", ous=["orderer"])
+    signer = SigningIdentity("OrdererOrg", oc, calib.key_pem(ok), csp)
+    reg1 = Registrar(str(tmp_path / "ord1"), signer, csp)
+    reg1.create_channel(blk)
+    cc, ck = org_ca.issue("cli@org1", "Org1", ous=["client"])
+    client = SigningIdentity("Org1", cc, calib.key_pem(ck), csp)
+    world = {"csp": csp, "signer": signer, "client": client,
+             "genesis": blk, "reg1": reg1, "tmp": tmp_path,
+             "org_ca": org_ca, "ord_ca": ord_ca}
+    yield world
+    reg1.close()
+    for extra in world.get("extra_regs", []):
+        extra.close()
+
+
+def _env(world, k):
+    b = RWSetBuilder()
+    b.add_write("cc", f"k{k}", b"v")
+    return protoutil.create_signed_tx(
+        "partchan", "cc", b.build().encode(), world["client"],
+        [world["client"]])
+
+
+def _order_txs(world, n, start=0):
+    support = world["reg1"].get_chain("partchan")
+    for k in range(start, start + n):
+        support.chain.order(_env(world, k), 0)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        got = sum(len(support.store.get_block_by_number(i).data.data)
+                  for i in range(1, support.store.height))
+        if got >= start + n:
+            return
+        time.sleep(0.02)
+    raise AssertionError("orderer did not cut")
+
+
+def _fetcher_from(support):
+    def fetch(lo, hi):
+        top = support.store.height if hi == 0 else min(
+            hi, support.store.height)
+        return [support.store.get_block_by_number(i)
+                for i in range(lo, top)]
+    return fetch
+
+
+def test_join_from_genesis_and_list(world):
+    reg2 = Registrar(str(world["tmp"] / "ord2"), world["signer"],
+                     world["csp"])
+    world.setdefault("extra_regs", []).append(reg2)
+    part = ChannelParticipation(reg2)
+    info = part.join(world["genesis"])
+    assert info.channel_id == "partchan"
+    assert part.list_channels() == [
+        {"name": "partchan", "height": 1, "status": "active"}]
+    with pytest.raises(ParticipationError):
+        part.join(world["genesis"])        # double join refused
+
+
+def test_onboard_from_config_block_replicates_chain(world):
+    _order_txs(world, 7)
+    src = world["reg1"].get_chain("partchan")
+    # the join block is the latest CONFIG block (genesis here)
+    join_block = src.store.get_block_by_number(0)
+    # ... but join at the TIP exercises replication: use a config
+    # block? genesis is the only config; onboard from tip-anchored
+    # genesis means height 0. Instead anchor at the current tip by
+    # treating the tip as the join target via replicate-then-open:
+    # the reference join block is always a config block, so fetch the
+    # chain and verify it ends at the tip's last-config (genesis).
+    reg2 = Registrar(str(world["tmp"] / "ord2"), world["signer"],
+                     world["csp"])
+    world.setdefault("extra_regs", []).append(reg2)
+    part = ChannelParticipation(reg2, block_fetcher=_fetcher_from(src))
+    support2 = part.join(join_block, as_follower=True)
+    # follower pulls the rest of the chain
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            support2.store.height < src.store.height:
+        time.sleep(0.05)
+    assert support2.store.height == src.store.height
+    for n in range(src.store.height):
+        assert protoutil.block_header_hash(
+            support2.store.get_block_by_number(n).header) == \
+            protoutil.block_header_hash(
+                src.store.get_block_by_number(n).header)
+    # followers refuse Broadcast
+    with pytest.raises(ChainHaltedError):
+        support2.chain.order(_env(world, 99), 0)
+    assert part.channel_info("partchan")["status"] == "follower"
+
+
+def _commit_config_update(world):
+    """Push a batch-size config update through the source orderer so
+    the chain carries a CONFIG block at height > 0 (the join anchor
+    onboarding needs)."""
+    from fabric_mod_tpu.channelconfig import (
+        compute_update, signed_update_envelope)
+    from fabric_mod_tpu.channelconfig.bundle import (
+        BATCH_SIZE, ORDERER, groups_of, set_group, set_value, values_of)
+    from fabric_mod_tpu.protos import messages as m
+    support = world["reg1"].get_chain("partchan")
+    cur = support.bundle().config
+    desired = m.ConfigGroup.decode(cur.channel_group.encode())
+    osec = groups_of(desired)[ORDERER]
+    bs = values_of(osec)[BATCH_SIZE]
+    bs.value = m.BatchSize(max_message_count=5,
+                           absolute_max_bytes=10 * 1024 * 1024,
+                           preferred_max_bytes=2 * 1024 * 1024).encode()
+    set_value(osec, BATCH_SIZE, bs)
+    set_group(desired, ORDERER, osec)
+    update = compute_update("partchan", cur, desired)
+    ocert, okey = world["ord_ca"].issue("admin@orderer", "OrdererOrg",
+                                        ous=["admin"])
+    oadmin = SigningIdentity("OrdererOrg", ocert, calib.key_pem(okey),
+                             world["csp"])
+    env = signed_update_envelope("partchan", update, [oadmin])
+    wrapped, seq = support.processor.process_config_update_msg(env)
+    support.chain.configure(wrapped, seq)
+    deadline = time.time() + 10
+    while time.time() < deadline and support.bundle().sequence == 0:
+        time.sleep(0.02)
+    assert support.bundle().sequence == 1
+    lc = support.writer.last_config
+    assert lc > 0
+    return support.store.get_block_by_number(lc)
+
+
+def test_forged_history_rejected(world, tmp_path):
+    """A malicious replication source whose chain does not end at the
+    join block must be rejected, and the half-joined channel must not
+    come up as active after restart."""
+    _order_txs(world, 4)
+    src = world["reg1"].get_chain("partchan")
+    join_block = _commit_config_update(world)
+
+    # forged source: serves a DIFFERENT chain (its own genesis)
+    other = genesis.standard_network(
+        "partchan", {"Org1": [calib.cert_pem(world["org_ca"].cert)]},
+        {"OrdererOrg": [calib.cert_pem(world["ord_ca"].cert)]},
+        batch_timeout="1s", max_message_count=2)
+    reg_evil = Registrar(str(tmp_path / "evil"), world["signer"],
+                         world["csp"])
+    world.setdefault("extra_regs", []).append(reg_evil)
+    reg_evil.create_channel(other)
+    evil_support = reg_evil.get_chain("partchan")
+    for k in range(12):
+        evil_support.chain.order(_env(world, k), 0)
+    deadline = time.time() + 10
+    while time.time() < deadline and evil_support.store.height <= \
+            join_block.header.number:
+        time.sleep(0.05)
+
+    reg2 = Registrar(str(world["tmp"] / "ord2"), world["signer"],
+                     world["csp"])
+    part = ChannelParticipation(
+        reg2, block_fetcher=_fetcher_from(evil_support))
+    with pytest.raises((ParticipationError, RegistrarError)):
+        part.join(join_block)
+    reg2.close()
+    # restart: the .joining marker keeps the partial chain inactive
+    reg3 = Registrar(str(world["tmp"] / "ord2"), world["signer"],
+                     world["csp"])
+    world.setdefault("extra_regs", []).append(reg3)
+    assert reg3.get_chain("partchan") is None
+    # an honest re-join resumes and completes
+    part3 = ChannelParticipation(reg3, block_fetcher=_fetcher_from(src))
+    support3 = part3.join(join_block)
+    assert support3.store.height == join_block.header.number + 1
+
+
+def test_remove_channel(world):
+    reg2 = Registrar(str(world["tmp"] / "ord2"), world["signer"],
+                     world["csp"])
+    world.setdefault("extra_regs", []).append(reg2)
+    part = ChannelParticipation(reg2)
+    part.join(world["genesis"])
+    part.remove("partchan")
+    assert reg2.get_chain("partchan") is None
+    with pytest.raises(ParticipationError):
+        part.channel_info("partchan")
+    # rejoin after remove works (storage was deleted)
+    part.join(world["genesis"])
+    assert part.channel_info("partchan")["height"] == 1
+
+
+def test_participation_rest_surface(world):
+    from fabric_mod_tpu.observability.opsserver import OperationsServer
+    reg2 = Registrar(str(world["tmp"] / "ord2"), world["signer"],
+                     world["csp"])
+    world.setdefault("extra_regs", []).append(reg2)
+    part = ChannelParticipation(reg2)
+    ops = OperationsServer(participation=part)
+    ops.start()
+    host, port = ops.addr
+    base = f"http://{host}:{port}/participation/v1/channels"
+    try:
+        with urllib.request.urlopen(base) as r:
+            assert json.loads(r.read()) == {"channels": []}
+        req = urllib.request.Request(base, method="POST", data=json.dumps(
+            {"config_block": base64.b64encode(
+                world["genesis"].encode()).decode()}).encode())
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 201
+            assert json.loads(r.read())["name"] == "partchan"
+        with urllib.request.urlopen(base + "/partchan") as r:
+            assert json.loads(r.read())["height"] == 1
+        req = urllib.request.Request(base + "/partchan",
+                                     method="DELETE")
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 204
+        with urllib.request.urlopen(base) as r:
+            assert json.loads(r.read()) == {"channels": []}
+    finally:
+        ops.stop()
+
+
+def test_follower_status_survives_restart(world):
+    """A follower channel must come back as a FOLLOWER after restart —
+    a non-member orderer must never restart into ordering (the
+    .follower marker; reference: the follower chain registry)."""
+    src = world["reg1"].get_chain("partchan")
+    _order_txs(world, 2)
+    reg2 = Registrar(str(world["tmp"] / "ord2"), world["signer"],
+                     world["csp"], block_fetcher=_fetcher_from(src))
+    part = ChannelParticipation(reg2,
+                                block_fetcher=_fetcher_from(src))
+    part.join(world["genesis"], as_follower=True)
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            reg2.get_chain("partchan").store.height < src.store.height:
+        time.sleep(0.05)
+    reg2.close()
+    # reopen: the marker keeps it a follower, and it keeps pulling
+    reg3 = Registrar(str(world["tmp"] / "ord2"), world["signer"],
+                     world["csp"], block_fetcher=_fetcher_from(src))
+    world.setdefault("extra_regs", []).append(reg3)
+    support3 = reg3.get_chain("partchan")
+    assert isinstance(support3.chain, FollowerChain)
+    with pytest.raises(ChainHaltedError):
+        support3.chain.order(_env(world, 77), 0)
+    _order_txs(world, 2, start=2)
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            support3.store.height < src.store.height:
+        time.sleep(0.05)
+    assert support3.store.height == src.store.height
+
+
+def test_ops_server_tls_client_auth(world, tmp_path):
+    """Participation rides the ops listener; with TLS + client CA
+    configured, an unauthenticated client is rejected at the handshake
+    (reference: operations TLS clientAuthRequired)."""
+    import ssl
+    from fabric_mod_tpu.comm.tls import TlsCA, write_pems
+    from fabric_mod_tpu.observability.opsserver import OperationsServer
+    ca = TlsCA()
+    scert, skey = ca.issue("ops.server", sans=("localhost", "127.0.0.1"))
+    ccert, ckey = ca.issue("ops.client")
+    pems = write_pems(str(tmp_path / "tls"), ca=ca.cert_pem,
+                      scert=scert, skey=skey, ccert=ccert, ckey=ckey)
+    reg2 = Registrar(str(world["tmp"] / "ord2"), world["signer"],
+                     world["csp"])
+    world.setdefault("extra_regs", []).append(reg2)
+    ops = OperationsServer(
+        participation=ChannelParticipation(reg2),
+        tls={"cert": pems["scert"], "key": pems["skey"],
+             "client_ca": pems["ca"]})
+    ops.start()
+    host, port = ops.addr
+    url = f"https://127.0.0.1:{port}/participation/v1/channels"
+    try:
+        anon = ssl.create_default_context(cafile=pems["ca"])
+        anon.check_hostname = False
+        with pytest.raises(Exception):
+            urllib.request.urlopen(url, context=anon, timeout=5).read()
+        authed = ssl.create_default_context(cafile=pems["ca"])
+        authed.check_hostname = False
+        authed.load_cert_chain(pems["ccert"], pems["ckey"])
+        with urllib.request.urlopen(url, context=authed, timeout=5) as r:
+            assert json.loads(r.read()) == {"channels": []}
+    finally:
+        ops.stop()

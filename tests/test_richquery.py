@@ -1,0 +1,151 @@
+"""Rich (Mango-selector) queries over JSON state.
+
+(reference test model: statecouchdb query tests + the marbles rich
+query samples — selector matching, sort/limit/bookmark paging,
+read-set recording without phantom protection.)
+"""
+import json
+import threading
+import time
+
+import pytest
+
+from fabric_mod_tpu.e2e import Network
+from fabric_mod_tpu.ledger import richquery
+from fabric_mod_tpu.ledger.kvledger import QueryExecutor, TxSimulator
+from fabric_mod_tpu.ledger.statedb import UpdateBatch, VersionedDB
+from fabric_mod_tpu.protos import messages as m
+
+
+def _doc(i, owner, size, color="red"):
+    return json.dumps({"owner": owner, "size": size, "color": color,
+                       "meta": {"idx": i}}).encode()
+
+
+@pytest.fixture()
+def db():
+    d = VersionedDB()
+    batch = UpdateBatch()
+    batch.put("cc", "m1", _doc(1, "alice", 5), (1, 0))
+    batch.put("cc", "m2", _doc(2, "bob", 10, "blue"), (1, 1))
+    batch.put("cc", "m3", _doc(3, "alice", 15), (1, 2))
+    batch.put("cc", "m4", _doc(4, "carol", 20, "blue"), (1, 3))
+    batch.put("cc", "m5", b"not-json", (1, 4))
+    d.apply_updates(batch, 1)
+    return d
+
+
+def test_selector_operators():
+    doc = {"owner": "alice", "size": 5, "tags": ["a"],
+           "meta": {"idx": 1}}
+    M = richquery.match_selector
+    assert M(doc, {"owner": "alice"})
+    assert not M(doc, {"owner": "bob"})
+    assert M(doc, {"size": {"$gt": 3, "$lte": 5}})
+    assert not M(doc, {"size": {"$gt": 5}})
+    assert M(doc, {"owner": {"$in": ["alice", "x"]}})
+    assert M(doc, {"owner": {"$nin": ["bob"]}})
+    assert M(doc, {"missing": {"$exists": False}})
+    assert M(doc, {"meta.idx": 1})
+    assert M(doc, {"$or": [{"owner": "bob"}, {"size": 5}]})
+    assert M(doc, {"$and": [{"owner": "alice"}, {"size": 5}]})
+    assert M(doc, {"$nor": [{"owner": "bob"}, {"size": 9}]})
+    assert M(doc, {"size": {"$not": {"$gt": 10}}})
+    assert not M(doc, {"size": {"$gt": "zzz"}})   # cross-type: no match
+    with pytest.raises(richquery.QueryError):
+        M(doc, {"size": {"$regex": "x"}})
+
+
+def test_query_executor_rich_query(db):
+    qe = QueryExecutor(db)
+    results, _ = qe.execute_query(
+        "cc", '{"selector": {"owner": "alice"}}')
+    assert [k for k, _ in results] == ["m1", "m3"]
+    # non-JSON value (m5) is silently unmatchable
+    results, _ = qe.execute_query("cc", '{"selector": {}}')
+    assert [k for k, _ in results] == ["m1", "m2", "m3", "m4"]
+
+
+def test_sort_limit_fields(db):
+    qe = QueryExecutor(db)
+    results, _ = qe.execute_query("cc", json.dumps({
+        "selector": {"size": {"$gt": 0}},
+        "sort": [{"size": "desc"}], "limit": 2,
+        "fields": ["owner", "size"]}))
+    assert [d["size"] for _, d in results] == [20, 15]
+    assert all(set(d) == {"owner", "size"} for _, d in results)
+    results, _ = qe.execute_query("cc", json.dumps({
+        "selector": {"size": {"$gt": 0}}, "sort": ["size"]}))
+    assert [d["size"] for _, d in results] == [5, 10, 15, 20]
+    with pytest.raises(richquery.QueryError):
+        qe.execute_query("cc", json.dumps({
+            "selector": {}, "sort": [{"size": "desc"},
+                                     {"owner": "asc"}]}))
+
+
+def test_bookmark_pagination(db):
+    qe = QueryExecutor(db)
+    seen = []
+    bookmark = ""
+    while True:
+        results, bookmark = qe.execute_query("cc", json.dumps(
+            {"selector": {}, "limit": 2, "bookmark": bookmark}))
+        if not results:
+            break
+        seen.extend(k for k, _ in results)
+        if len(results) < 2:
+            break
+    assert seen == ["m1", "m2", "m3", "m4"]
+
+
+def test_simulator_records_reads_not_phantoms(db):
+    sim = TxSimulator(db, "tx1")
+    results, _ = sim.execute_query(
+        "cc", '{"selector": {"owner": "alice"}}')
+    assert [k for k, _ in results] == ["m1", "m3"]
+    rwset = sim.done().ns_rwset
+    cc = next(n for n in rwset if n.namespace == "cc")
+    kv = m.KVRWSet.decode(cc.rwset)
+    read_keys = {r.key for r in kv.reads}
+    assert read_keys == {"m1", "m3"}
+    # no range fingerprint: rich queries are not phantom-protected
+    assert not kv.range_queries_info
+
+
+def test_e2e_rich_query_through_chaincode(tmp_path):
+    net = Network(str(tmp_path), batch_timeout="100ms",
+                  max_message_count=10)
+    try:
+        for i, (owner, size) in enumerate(
+                [("alice", 5), ("bob", 10), ("alice", 15)]):
+            net.invoke([b"put", b"marble%d" % i, _doc(i, owner, size)])
+        client = net.deliver_client()
+        t = threading.Thread(
+            target=lambda: client.run(idle_timeout_s=5.0), daemon=True)
+        t.start()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            done = sum(
+                len(net.ledger.get_block_by_number(i).data.data)
+                for i in range(1, net.ledger.height))
+            if done >= 3:
+                break
+            time.sleep(0.05)
+        client.stop()
+        t.join(timeout=5)
+        # endorse a rich query against committed state
+        from fabric_mod_tpu.protos import protoutil
+        sp, _prop, _txid = protoutil.create_chaincode_proposal(
+            net.channel_id, "mycc",
+            [b"query",
+             json.dumps({"selector": {"owner": "alice"}}).encode()],
+            net.client)
+        resp = net.endorsers["Org1"].process_proposal(sp)
+        assert resp.response.status == 200
+        payload = json.loads(resp.response.payload)
+        keys = [r["key"] for r in payload["results"]]
+        assert keys == ["marble0", "marble2"]
+        assert all(r["doc"]["owner"] == "alice"
+                   for r in payload["results"])
+    finally:
+        net.close()
