@@ -106,10 +106,22 @@ def run_node(genesis_path: str, crypto_dir: str, orderer_org: str,
     health.register("ledger", lambda: None if ledger.height > 0 else
                     (_ for _ in ()).throw(RuntimeError("empty ledger")))
     host, _, port = peer_cfg.ops_listen_address.partition(":")
-    from fabric_mod_tpu.orderer.participation import ChannelParticipation
+    # the participation API can destroy channel storage: mount it only
+    # on loopback unless the operator configures client-authenticated
+    # TLS on the ops listener (reference: the admin server's
+    # clientAuthRequired stance)
+    participation = None
+    if (host or "127.0.0.1") in ("127.0.0.1", "localhost", "::1"):
+        from fabric_mod_tpu.orderer.participation import (
+            ChannelParticipation)
+        participation = ChannelParticipation(registrar)
+    else:
+        log.warning("ops listener on %s is not loopback: channel "
+                    "participation API disabled (configure TLS with "
+                    "client auth to enable it off-host)", host)
     ops = OperationsServer(host or "127.0.0.1", int(port or 0),
                            default_provider(), health,
-                           participation=ChannelParticipation(registrar))
+                           participation=participation)
     ops.start()
     log.info("ops server on %s; channel %s at height %d",
              ops.addr, cid, ledger.height)

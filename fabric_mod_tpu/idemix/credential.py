@@ -222,7 +222,44 @@ def verify(ik: IssuerKey, sig: Signature, msg: bytes,
     # THE pairing equation: e(A', W) == e(Abar, g2)
     if pairing(sig.A_prime, ik.W) != pairing(sig.A_bar, ik.g2):
         return False
+    return _verify_schnorr(ik, sig, msg, disclosed)
 
+
+def batch_verify(ik: IssuerKey, items, use_device: bool = True):
+    """Verify many presentations at once: the pairing equations — the
+    ~85% cost of Ver — run as ONE batched device dispatch
+    (ops/fp256bn_dev.pairing_check_batch, per idemix/KERNEL_PLAN.md
+    R4.4); the cheap Schnorr/Fiat-Shamir algebra stays host-side.
+
+    `items`: [(sig, msg, disclosed)];  -> [bool] per item.
+    (reference behavior anchor: idemix/signature.go:243 Ver, applied
+    per block of presentations — BASELINE config #4)."""
+    results = [False] * len(items)
+    todo = []                          # (index, sig)
+    for idx, (sig, _msg, _d) in enumerate(items):
+        if sig.A_prime is not None and sig.A_bar is not None:
+            todo.append(idx)
+    if todo:
+        if use_device:
+            from fabric_mod_tpu.ops.fp256bn_dev import pairing_check_batch
+            a_pts = [items[i][0].A_prime for i in todo]
+            b_pts = [items[i][0].A_bar.neg() for i in todo]
+            ok = pairing_check_batch(a_pts, ik.W, b_pts, ik.g2)
+            pair_ok = {i: bool(o) for i, o in zip(todo, ok)}
+        else:
+            pair_ok = {i: pairing(items[i][0].A_prime, ik.W) ==
+                       pairing(items[i][0].A_bar, ik.g2) for i in todo}
+        for i in todo:
+            if pair_ok[i]:
+                sig, msg, disclosed = items[i]
+                results[i] = _verify_schnorr(ik, sig, msg, disclosed)
+    return results
+
+
+def _verify_schnorr(ik: IssuerKey, sig: Signature, msg: bytes,
+                    disclosed: Dict[int, int]) -> bool:
+    """The non-pairing remainder of Ver: recompute the Fiat-Shamir
+    commitments from the responses and check the challenge."""
     c = sig.c
     # t1' = A'^z_e * HRand^z_r2 * (Abar/B')^-c
     t1 = g1_add(g1_mul(sig.z_e, sig.A_prime),

@@ -72,10 +72,14 @@ class QueryExecutor:
         return got[0] if got else None
 
     def _execute_query_versioned(self, ns: str, query):
-        """Shared rich-query core: ([(key, doc, version)], bookmark)."""
+        """Shared rich-query core: ([(key, doc, version)], bookmark).
+        A bookmark bounds the scan start (execute skips the boundary
+        key itself), so each page costs what remains, not the whole
+        namespace."""
         from fabric_mod_tpu.ledger import richquery
         q = richquery.RichQuery.parse(query)
-        rows = self._db.get_state_range(ns, "", "")
+        start = q.bookmark if (q.bookmark and not q.sort) else ""
+        rows = self._db.get_state_range(ns, start, "")
         return richquery.execute(rows, q)
 
     def execute_query(self, ns: str, query):

@@ -205,6 +205,8 @@ def execute(rows: Iterable[Tuple[str, bytes, tuple]], query: RichQuery
         raise QueryError("bookmark pagination requires an unsorted query")
     matches: List[Tuple[str, Any, tuple]] = []
     limit = query.limit
+    if limit == 0:
+        return [], ""
     for key, raw, ver in rows:
         if query.bookmark and key <= query.bookmark:
             continue
@@ -228,7 +230,10 @@ def execute(rows: Iterable[Tuple[str, bytes, tuple]], query: RichQuery
                      reverse=(directions == {"desc"}))
         if limit is not None:
             matches = matches[:limit]
-    bookmark = matches[-1][0] if matches else ""
+    # sorted queries cannot be continued (passing a bookmark back is
+    # rejected above): return an empty bookmark so clients can detect
+    # pagination is unavailable instead of erroring on page 2
+    bookmark = matches[-1][0] if matches and not query.sort else ""
     if query.fields:
         matches = [(k, _project(d, query.fields), v)
                    for k, d, v in matches]

@@ -1,0 +1,486 @@
+"""Batched FP256BN optimal-ate pairing on device (JAX).
+
+(reference: the fabric-amcl FP256BN pairing behind idemix —
+idemix/util.go:13-21, consumed by Signature.Ver at
+idemix/signature.go:243.  Semantics are pinned by the host
+implementation in idemix/fp256bn.py; this module reproduces them
+batched, per idemix/KERNEL_PLAN.md.)
+
+Design (KERNEL_PLAN.md §2-3):
+* The G2 arguments of idemix's pairing checks are SHARED across a
+  batch (the issuer's W and the fixed g2), so all G2 arithmetic — the
+  Miller loop's point doublings/additions and line slopes — is
+  precomputed ONCE per issuer on host as a static schedule of sparse
+  line coefficients.  The device work is only the per-signature line
+  evaluation l(P_i) and the Fp12 square/multiply chain, batched over
+  signatures on the limb layer of ops/limbs.py (batch axis = lanes).
+* Sparse lines: with the M-type twist untwist psi(x',y') =
+  (x' v^2/xi, y' v w/xi), the line through T with slope lam' evaluated
+  at an Fp point (xP, yP) is
+      l = yP·1  +  A·(v·w)  +  (B·xP)·(v^2·w),
+  A = (lam'·xT − yT)/xi,  B = −lam'/xi  — three nonzero Fp2 slots,
+  so the accumulator multiply is a 42-mont sparse mul, not 54.
+* Final exponentiation: easy part (conj/inv + Frobenius), then the
+  Devegili–Scott–Dominguez u-chain for the hard part — 3 static
+  |u|-exponentiations in the cyclotomic subgroup (63-step lax.scan)
+  plus ~13 Fp12 muls; NOT the naive 766-bit exponent.
+* Equality checks e(A,W) == e(Abar,g2) run as
+  e(A,W)·e(−Abar,g2) == 1: two Miller loops, one shared final exp.
+
+Field elements are (..., K) int32 lazy limbs in the Montgomery domain
+(ops/limbs.py); Fp2/Fp6/Fp12 are nested tuples (pytrees), broadcast
+over leading batch axes.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import numpy as np
+
+from fabric_mod_tpu.idemix import fp256bn as host
+from fabric_mod_tpu.ops import limbs
+
+SPEC = limbs.FieldSpec.make("fp256bn.p", host.P)
+_R = 1 << limbs.RBITS
+
+
+def _mont_np(x: int) -> np.ndarray:
+    """Host int -> canonical limbs of x*R mod p (Montgomery form)."""
+    return limbs.int_to_limbs((x % host.P) * _R % host.P)
+
+
+def _mont_fp2_np(x: "host.Fp2") -> np.ndarray:
+    """(2, K) Montgomery limbs of an Fp2 constant."""
+    return np.stack([_mont_np(x.a), _mont_np(x.b)])
+
+
+# ---------------------------------------------------------------------------
+# Device tower arithmetic.  Fp = (..., K); Fp2 = (a, b); Fp6 = (c0,c1,c2);
+# Fp12 = (c0, c1).  All ops stay in the Montgomery domain.
+# ---------------------------------------------------------------------------
+
+def f2_add(x, y):
+    return (limbs.add(x[0], y[0]), limbs.add(x[1], y[1]))
+
+
+def f2_sub(x, y):
+    return (limbs.sub(x[0], y[0]), limbs.sub(x[1], y[1]))
+
+
+def f2_neg(x):
+    return (limbs.carry2(-x[0]), limbs.carry2(-x[1]))
+
+
+def f2_conj(x):
+    return (x[0], limbs.carry2(-x[1]))
+
+
+def f2_mul(x, y):
+    """Karatsuba: 3 Montgomery muls."""
+    t0 = limbs.mont_mul(x[0], y[0], SPEC)
+    t1 = limbs.mont_mul(x[1], y[1], SPEC)
+    t2 = limbs.mont_mul(limbs.add(x[0], x[1]), limbs.add(y[0], y[1]), SPEC)
+    return (limbs.sub(t0, t1), limbs.sub(t2, limbs.add(t0, t1)))
+
+
+def f2_sqr(x):
+    """(a+b)(a-b), 2ab: 2 Montgomery muls."""
+    a, b = x
+    return (limbs.mont_mul(limbs.add(a, b), limbs.sub(a, b), SPEC),
+            limbs.mul_small(limbs.mont_mul(a, b, SPEC), 2))
+
+
+def f2_mul_fp(x, s):
+    """Fp2 scaled by an Fp element: 2 muls."""
+    return (limbs.mont_mul(x[0], s, SPEC), limbs.mont_mul(x[1], s, SPEC))
+
+
+def f2_mul_xi(x):
+    """xi = 1 + i: (a - b, a + b), adds only."""
+    return (limbs.sub(x[0], x[1]), limbs.add(x[0], x[1]))
+
+
+def f2_inv(x):
+    d = limbs.inv_mont(
+        limbs.add(limbs.mont_sqr(x[0], SPEC), limbs.mont_sqr(x[1], SPEC)),
+        SPEC)
+    return (limbs.mont_mul(x[0], d, SPEC),
+            limbs.carry2(-limbs.mont_mul(x[1], d, SPEC)))
+
+
+def f6_add(x, y):
+    return tuple(f2_add(a, b) for a, b in zip(x, y))
+
+
+def f6_sub(x, y):
+    return tuple(f2_sub(a, b) for a, b in zip(x, y))
+
+
+def f6_neg(x):
+    return tuple(f2_neg(a) for a in x)
+
+
+def f6_mul(x, y):
+    """Toom-style 6-mul Fp6 product (18 Montgomery muls)."""
+    a0, a1, a2 = x
+    b0, b1, b2 = y
+    t0, t1, t2 = f2_mul(a0, b0), f2_mul(a1, b1), f2_mul(a2, b2)
+    c0 = f2_add(f2_mul_xi(f2_sub(f2_mul(f2_add(a1, a2), f2_add(b1, b2)),
+                                 f2_add(t1, t2))), t0)
+    c1 = f2_add(f2_sub(f2_mul(f2_add(a0, a1), f2_add(b0, b1)),
+                       f2_add(t0, t1)), f2_mul_xi(t2))
+    c2 = f2_add(f2_sub(f2_mul(f2_add(a0, a2), f2_add(b0, b2)),
+                       f2_add(t0, t2)), t1)
+    return (c0, c1, c2)
+
+
+def f6_mul_sparse12(x, b1, b2):
+    """x * Fp6(0, b1, b2): 15 Montgomery muls."""
+    a0, a1, a2 = x
+    t1, t2 = f2_mul(a1, b1), f2_mul(a2, b2)
+    c0 = f2_mul_xi(f2_sub(f2_mul(f2_add(a1, a2), f2_add(b1, b2)),
+                          f2_add(t1, t2)))
+    c1 = f2_add(f2_sub(f2_mul(f2_add(a0, a1), b1), t1), f2_mul_xi(t2))
+    c2 = f2_add(f2_sub(f2_mul(f2_add(a0, a2), b2), t2), t1)
+    return (c0, c1, c2)
+
+
+def f6_mul_fp(x, s):
+    return tuple(f2_mul_fp(a, s) for a in x)
+
+
+def f6_mul_v(x):
+    return (f2_mul_xi(x[2]), x[0], x[1])
+
+
+def f6_sqr(x):
+    return f6_mul(x, x)
+
+
+def f6_inv(x):
+    a0, a1, a2 = x
+    t0 = f2_sub(f2_sqr(a0), f2_mul_xi(f2_mul(a1, a2)))
+    t1 = f2_sub(f2_mul_xi(f2_sqr(a2)), f2_mul(a0, a1))
+    t2 = f2_sub(f2_sqr(a1), f2_mul(a0, a2))
+    d = f2_add(f2_mul(a0, t0),
+               f2_add(f2_mul_xi(f2_mul(a2, t1)), f2_mul_xi(f2_mul(a1, t2))))
+    di = f2_inv(d)
+    return (f2_mul(t0, di), f2_mul(t1, di), f2_mul(t2, di))
+
+
+def f12_mul(x, y):
+    a0, a1 = x
+    b0, b1 = y
+    t0 = f6_mul(a0, b0)
+    t1 = f6_mul(a1, b1)
+    return (f6_add(t0, f6_mul_v(t1)),
+            f6_sub(f6_mul(f6_add(a0, a1), f6_add(b0, b1)), f6_add(t0, t1)))
+
+
+def f12_sqr(x):
+    a0, a1 = x
+    t0 = f6_mul(a0, a1)
+    c0 = f6_sub(f6_mul(f6_add(a0, a1), f6_add(a0, f6_mul_v(a1))),
+                f6_add(t0, f6_mul_v(t0)))
+    return (c0, f6_add(t0, t0))
+
+
+def f12_conj(x):
+    return (x[0], f6_neg(x[1]))
+
+
+def f12_inv(x):
+    t = f6_inv(f6_sub(f6_mul(x[0], x[0]), f6_mul_v(f6_mul(x[1], x[1]))))
+    return (f6_mul(x[0], t), f6_neg(f6_mul(x[1], t)))
+
+
+def f12_mul_line(f, yp, A, Bxp):
+    """f * l where l = yp·1 + A·(v·w) + Bxp·(v^2·w)  — the sparse line
+    (l.c0 = (yp, 0, 0); l.c1 = (0, A, Bxp)): 12 + 30 = 42 muls."""
+    a0, a1 = f
+    l1_mul = functools.partial(f6_mul_sparse12, b1=A, b2=Bxp)
+    t0 = f6_mul_fp(a0, yp)              # a0 * l0
+    t1 = l1_mul(a1)                     # a1 * l1
+    c1 = f6_add(l1_mul(a0), f6_mul_fp(a1, yp))
+    return (f6_add(t0, f6_mul_v(t1)), c1)
+
+
+# Frobenius constants (Montgomery, numpy) — x -> x^p on Fp12
+_F61 = _mont_fp2_np(host._FROB6_1)
+_F62 = _mont_fp2_np(host._FROB6_2)
+_F12 = _mont_fp2_np(host._FROB12)
+_F12_61 = _mont_fp2_np(host._FROB12 * host._FROB6_1)
+_F12_62 = _mont_fp2_np(host._FROB12 * host._FROB6_2)
+
+
+def f12_frobenius(x):
+    c0, c1 = x
+    f0 = (f2_conj(c0[0]),
+          f2_mul(f2_conj(c0[1]), tuple(_F61)),
+          f2_mul(f2_conj(c0[2]), tuple(_F62)))
+    f1 = (f2_mul(f2_conj(c1[0]), tuple(_F12)),
+          f2_mul(f2_conj(c1[1]), tuple(_F12_61)),
+          f2_mul(f2_conj(c1[2]), tuple(_F12_62)))
+    return (f0, f1)
+
+
+def f12_one(shape_like):
+    """Montgomery one broadcast to the batch shape of `shape_like`."""
+    import jax.numpy as jnp
+    one = jnp.broadcast_to(SPEC.one_mont, shape_like.shape).astype(jnp.int32)
+    zero = jnp.zeros_like(one)
+    z2 = (zero, zero)
+    return (((one, zero), z2, z2), (z2, z2, z2))
+
+
+def f12_is_one(x):
+    """(batch,) bool: is x == 1 (all coefficients canonical-checked)."""
+    import jax.numpy as jnp
+    (c00, c01, c02), (c10, c11, c12) = x
+    ok = limbs.eq_zero(limbs.sub(c00[0], SPEC.one_mont), SPEC)
+    for f2 in (c01, c02, c10, c11, c12):
+        ok &= limbs.eq_zero(f2[0], SPEC) & limbs.eq_zero(f2[1], SPEC)
+    ok &= limbs.eq_zero(c00[1], SPEC)
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# Host: static line schedule per G2 point (shared across the batch)
+# ---------------------------------------------------------------------------
+
+class LineSchedule:
+    """Stacked per-step line coefficients for one G2 point.
+
+    Arrays (all numpy, Montgomery limbs):
+      is_add: (N,) bool — add-step (no squaring before the multiply)
+      A, B:   (N, 2, K) — the Fp2 line constants per step
+      corr_A, corr_B: (2, 2, K) — the two Frobenius correction lines
+    """
+
+    def __init__(self, is_add, A, B, corr_A, corr_B):
+        self.is_add = is_add
+        self.A = A
+        self.B = B
+        self.corr_A = corr_A
+        self.corr_B = corr_B
+
+
+@functools.lru_cache(maxsize=32)
+def _schedule_cached(qx_a: int, qx_b: int, qy_a: int, qy_b: int
+                     ) -> LineSchedule:
+    q = host.G2(host.Fp2(qx_a, qx_b), host.Fp2(qy_a, qy_b))
+    return _build_schedule(q)
+
+
+def line_schedule(q: "host.G2") -> LineSchedule:
+    return _schedule_cached(q.x.a, q.x.b, q.y.a, q.y.b)
+
+
+def _build_schedule(q: "host.G2") -> LineSchedule:
+    """Replicates host.miller_loop's control flow on G2 only, recording
+    A = (lam·xT − yT)/xi and B = −lam/xi per line (host math; runs once
+    per issuer and is cached)."""
+    xi_inv = host.XI.inv()
+    state = {"t": q}
+    steps: List[Tuple[bool, "host.Fp2", "host.Fp2"]] = []
+
+    def rec(q2, is_add: bool) -> None:
+        q1 = state["t"]
+        assert not (q1.x == q2.x and (q1.y + q2.y).is_zero()), \
+            "degenerate (vertical) line in pairing schedule"
+        if q1 == q2:
+            lam = (q1.x.sqr() * 3) * (q1.y * 2).inv()
+        else:
+            lam = (q2.y - q1.y) * (q2.x - q1.x).inv()
+        A = (lam * q1.x - q1.y) * xi_inv
+        Bc = -lam * xi_inv
+        x3 = lam.sqr() - q1.x - q2.x
+        state["t"] = host.G2(x3, lam * (q1.x - x3) - q1.y)
+        steps.append((is_add, A, Bc))
+
+    e = abs(6 * host.U + 2)
+    for bit in bin(e)[3:]:
+        rec(state["t"], False)
+        if bit == "1":
+            rec(q, True)
+    # 6u+2 < 0 for this curve: conjugate f (device side) and negate T
+    assert 6 * host.U + 2 < 0
+    state["t"] = state["t"].neg()
+    n_main = len(steps)
+    q1f = host.g2_frobenius(q)
+    q2f = host.g2_frobenius(q1f).neg()
+    rec(q1f, True)
+    rec(q2f, True)
+    main, corr = steps[:n_main], steps[n_main:]
+    return LineSchedule(
+        is_add=np.array([s[0] for s in main], np.bool_),
+        A=np.stack([_mont_fp2_np(s[1]) for s in main]),
+        B=np.stack([_mont_fp2_np(s[2]) for s in main]),
+        corr_A=np.stack([_mont_fp2_np(s[1]) for s in corr]),
+        corr_B=np.stack([_mont_fp2_np(s[2]) for s in corr]),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Device: Miller loop + final exponentiation
+# ---------------------------------------------------------------------------
+
+def miller_batch(xp_m, yp_m, sched: LineSchedule):
+    """Batched Miller loop: (batch, K) Montgomery G1 coords against one
+    precomputed schedule.  One lax.scan step = Fp12 sqr (skipped via
+    select on add-steps) + sparse line mul."""
+    import jax
+    import jax.numpy as jnp
+
+    f = f12_one(xp_m)
+
+    def body(f, step):
+        is_add, A, B = step
+        fsq = f12_sqr(f)
+        f = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(is_add, a, b), f, fsq)
+        Bxp = f2_mul_fp((B[0], B[1]), xp_m)
+        f = f12_mul_line(f, yp_m, (A[0], A[1]), Bxp)
+        return f, None
+
+    f, _ = jax.lax.scan(
+        body, f,
+        (jnp.asarray(sched.is_add), jnp.asarray(sched.A),
+         jnp.asarray(sched.B)))
+    f = f12_conj(f)                      # 6u+2 < 0
+    for i in range(2):                   # Frobenius correction lines
+        A = tuple(jnp.asarray(sched.corr_A[i]))
+        B = tuple(jnp.asarray(sched.corr_B[i]))
+        f = f12_mul_line(f, yp_m, A, f2_mul_fp(B, xp_m))
+    return f
+
+
+def _pow_abs_u(f):
+    """f^|u| via a static-bit square-and-multiply lax.scan (f must be
+    in the cyclotomic subgroup; 63 uniform steps)."""
+    import jax
+    import jax.numpy as jnp
+    e = abs(host.U)
+    nbits = e.bit_length()
+    bits = np.array([(e >> (nbits - 1 - i)) & 1 for i in range(nbits)],
+                    np.bool_)
+    acc = f12_one(f[0][0][0])
+
+    def body(acc, bit):
+        acc = f12_sqr(acc)
+        withmul = f12_mul(acc, f)
+        acc = jax.tree_util.tree_map(
+            lambda w, a: jnp.where(bit, w, a), withmul, acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(body, acc, jnp.asarray(bits))
+    return acc
+
+
+def _pow_u(f):
+    """f^u (u < 0): conj of f^|u| — cyclotomic inverse is conjugation."""
+    assert host.U < 0
+    return f12_conj(_pow_abs_u(f))
+
+
+def final_exp_batch(f):
+    """f^((p^12-1)/r): easy part, then the DSD u-chain hard part
+    (KERNEL_PLAN.md §3 — NOT the naive 766-bit exponent)."""
+    # easy: f^(p^6-1) then ^(p^2+1)
+    f = f12_mul(f12_conj(f), f12_inv(f))
+    f = f12_mul(f12_frobenius(f12_frobenius(f)), f)
+    # hard part (Devegili–Scott–Dominguez)
+    fu = _pow_u(f)
+    fu2 = _pow_u(fu)
+    fu3 = _pow_u(fu2)
+    fp = f12_frobenius(f)
+    fp2 = f12_frobenius(fp)
+    fp3 = f12_frobenius(fp2)
+    y0 = f12_mul(f12_mul(fp, fp2), fp3)
+    y1 = f12_conj(f)
+    y2 = f12_frobenius(f12_frobenius(fu2))
+    y3 = f12_conj(f12_frobenius(fu))
+    y4 = f12_conj(f12_mul(fu, f12_frobenius(fu2)))
+    y5 = f12_conj(fu2)
+    y6 = f12_conj(f12_mul(fu3, f12_frobenius(fu3)))
+    t0 = f12_mul(f12_mul(f12_sqr(y6), y4), y5)
+    t1 = f12_mul(f12_mul(y3, y5), t0)
+    t0 = f12_mul(t0, y2)
+    t1 = f12_sqr(f12_mul(f12_sqr(t1), t0))
+    t0 = f12_mul(t1, y1)
+    t1 = f12_mul(t1, y0)
+    t0 = f12_sqr(t0)
+    return f12_mul(t0, t1)
+
+
+# ---------------------------------------------------------------------------
+# The verify surface
+# ---------------------------------------------------------------------------
+
+def _g1_batch_to_mont_np(points) -> Tuple[np.ndarray, np.ndarray]:
+    """[host.G1] -> two (batch, K) canonical Montgomery limb arrays."""
+    xs = np.stack([_mont_np(p.x) for p in points])
+    ys = np.stack([_mont_np(p.y) for p in points])
+    return xs, ys
+
+
+@functools.lru_cache(maxsize=8)
+def _check_fn():
+    import jax
+
+    def run(ax, ay, bx, by, s1_is_add, s1_A, s1_B, s1_cA, s1_cB,
+            s2_is_add, s2_A, s2_B, s2_cA, s2_cB):
+        s1 = LineSchedule(s1_is_add, s1_A, s1_B, s1_cA, s1_cB)
+        s2 = LineSchedule(s2_is_add, s2_A, s2_B, s2_cA, s2_cB)
+        ml = f12_mul(miller_batch(ax, ay, s1), miller_batch(bx, by, s2))
+        return f12_is_one(final_exp_batch(ml))
+
+    return jax.jit(run)
+
+
+def pairing_check_batch(a_points, q1: "host.G2",
+                        b_points, q2: "host.G2") -> np.ndarray:
+    """(batch,) bool: e(A_i, Q1) * e(B_i, Q2) == 1 for each i.
+
+    For idemix Ver's `e(A', W) == e(Abar, g2)` pass B_i = −Abar_i
+    (negation is host-side).  Q1/Q2 schedules are cached per point —
+    the per-issuer precompute amortizes across every batch."""
+    assert len(a_points) == len(b_points)
+    s1, s2 = line_schedule(q1), line_schedule(q2)
+    ax, ay = _g1_batch_to_mont_np(a_points)
+    bx, by = _g1_batch_to_mont_np(b_points)
+    out = _check_fn()(
+        ax, ay, bx, by,
+        s1.is_add, s1.A, s1.B, s1.corr_A, s1.corr_B,
+        s2.is_add, s2.A, s2.B, s2.corr_A, s2.corr_B)
+    return np.asarray(out)
+
+
+def pairing_batch(p_points, q: "host.G2"):
+    """Batched full pairings e(P_i, Q) as device Fp12 values — used by
+    the differential tests against the host implementation."""
+    import jax
+    sched = line_schedule(q)
+    xs, ys = _g1_batch_to_mont_np(p_points)
+
+    @jax.jit
+    def run(xp, yp):
+        return final_exp_batch(miller_batch(xp, yp, sched))
+
+    return run(xs, ys)
+
+
+def f12_to_host(dev_f12, index: int = 0) -> "host.Fp12":
+    """One batch element of a device Fp12 -> host Fp12 (for tests)."""
+    def fp_of(x):
+        canon = limbs.canonical(np.asarray(x)[index], SPEC)
+        v = limbs.limbs_to_int(np.asarray(canon))
+        return v * pow(_R, -1, host.P) % host.P
+
+    (c00, c01, c02), (c10, c11, c12) = dev_f12
+    def fp2_of(t):
+        return host.Fp2(fp_of(t[0]), fp_of(t[1]))
+    return host.Fp12(
+        host.Fp6(fp2_of(c00), fp2_of(c01), fp2_of(c02)),
+        host.Fp6(fp2_of(c10), fp2_of(c11), fp2_of(c12)))

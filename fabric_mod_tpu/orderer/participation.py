@@ -78,9 +78,13 @@ class FollowerChain:
 
     # -- the pull loop ----------------------------------------------------
     def poll_once(self) -> int:
-        """One catch-up attempt; returns blocks appended."""
+        """One catch-up attempt; returns blocks appended.  Every pulled
+        block is verified against the channel's BlockValidation policy
+        (the fetch source is untrusted — same gate as the raft
+        catch-up's _append_fetched; reference: cluster.VerifyBlocks)."""
         if self._fetch is None:
             return 0
+        from fabric_mod_tpu.peer.mcs import MessageCryptoService
         store = self._support.store
         h = store.height
         try:
@@ -94,6 +98,11 @@ class FollowerChain:
             if store.height and \
                     block.header.previous_hash != store.last_block_hash:
                 break                      # broken chain: stop pulling
+            try:
+                MessageCryptoService(self._support.bundle).verify_block(
+                    self._support.channel_id, block)
+            except Exception:
+                break                      # unverifiable: stop pulling
             if _is_config_block(block):
                 envs = protoutil.get_envelopes(block)
                 try:

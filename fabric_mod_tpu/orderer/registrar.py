@@ -200,6 +200,12 @@ class Registrar:
                 shutil.rmtree(path, ignore_errors=True)
             os.makedirs(path, exist_ok=True)
             store = BlockStore(path)
+            if as_follower:
+                # BEFORE clearing .joining: a crash between the two
+                # must never restart a requested follower as an
+                # ordering member
+                with open(os.path.join(path, ".follower"), "w"):
+                    pass
             if join_block.header.number == 0:
                 if store.height == 0:
                     store.add_block(join_block)
@@ -208,9 +214,6 @@ class Registrar:
                     pass
                 replicate_chain(store, join_block, fetch)
                 os.remove(marker)
-            if as_follower:
-                with open(os.path.join(path, ".follower"), "w"):
-                    pass
             # bundle from the latest config block now in the store
             tip = store.get_block_by_number(store.height - 1)
             lc = last_config_index(tip)
@@ -225,6 +228,10 @@ class Registrar:
             support = ChainSupport(cid, store, bundle, self._signer,
                                    self._csp, self._verify_many,
                                    chain_factory=factory)
+            # start BEFORE publishing (still holding the _busy
+            # reservation): a concurrent remove must never halt a
+            # chain that was never started
+            support.start()
             with self._lock:
                 self._chains[cid] = support
         except Exception:
@@ -234,7 +241,6 @@ class Registrar:
         finally:
             with self._lock:
                 self._busy.discard(cid)
-        support.start()
         return support
 
     def remove_channel(self, channel_id: str) -> None:
