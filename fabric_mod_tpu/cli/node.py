@@ -106,22 +106,33 @@ def run_node(genesis_path: str, crypto_dir: str, orderer_org: str,
     health.register("ledger", lambda: None if ledger.height > 0 else
                     (_ for _ in ()).throw(RuntimeError("empty ledger")))
     host, _, port = peer_cfg.ops_listen_address.partition(":")
+    # operations TLS (reference: core.yaml operations.tls.*); with a
+    # client CA, clients must present certs
+    ops_tls = None
+    if peer_cfg.ops_tls_cert and peer_cfg.ops_tls_key:
+        ops_tls = {"cert": peer_cfg.ops_tls_cert,
+                   "key": peer_cfg.ops_tls_key,
+                   "client_ca": peer_cfg.ops_tls_client_ca or None}
     # the participation API can destroy channel storage: mount it only
-    # on loopback unless the operator configures client-authenticated
-    # TLS on the ops listener (reference: the admin server's
+    # on loopback, or off-loopback strictly behind client-
+    # authenticated TLS (reference: the admin server's
     # clientAuthRequired stance)
     participation = None
-    if (host or "127.0.0.1") in ("127.0.0.1", "localhost", "::1"):
+    loopback = (host or "127.0.0.1") in ("127.0.0.1", "localhost",
+                                         "::1")
+    if loopback or (ops_tls and ops_tls["client_ca"]):
         from fabric_mod_tpu.orderer.participation import (
             ChannelParticipation)
         participation = ChannelParticipation(registrar)
     else:
-        log.warning("ops listener on %s is not loopback: channel "
-                    "participation API disabled (configure TLS with "
-                    "client auth to enable it off-host)", host)
+        log.warning(
+            "ops listener on %s is not loopback and has no client-"
+            "authenticated TLS (operations.tls.cert/key + "
+            "clientRootCAs): channel participation API disabled",
+            host)
     ops = OperationsServer(host or "127.0.0.1", int(port or 0),
                            default_provider(), health,
-                           participation=participation)
+                           participation=participation, tls=ops_tls)
     ops.start()
     log.info("ops server on %s; channel %s at height %d",
              ops.addr, cid, ledger.height)

@@ -42,6 +42,9 @@ class PeerConfig:
     ledger_dir: str = "data/ledgers"
     validator_pool_size: int = 0        # 0 = device-batched (no pool)
     ops_listen_address: str = "127.0.0.1:0"
+    ops_tls_cert: str = ""              # operations TLS (reference:
+    ops_tls_key: str = ""               # core.yaml operations.tls.*)
+    ops_tls_client_ca: str = ""
     log_spec: str = "info"
     deliver_queue_size: int = 8
     bccsp: str = "TPU"                  # TPU | SW
@@ -50,6 +53,9 @@ class PeerConfig:
         "ledger_dir": "peer.fileSystemPath",
         "validator_pool_size": "peer.validatorPoolSize",
         "ops_listen_address": "operations.listenAddress",
+        "ops_tls_cert": "operations.tls.cert.file",
+        "ops_tls_key": "operations.tls.key.file",
+        "ops_tls_client_ca": "operations.tls.clientRootCAs.file",
         "log_spec": "logging.spec",
         "deliver_queue_size": "peer.deliverclient.queueSize",
         "bccsp": "peer.BCCSP.Default",

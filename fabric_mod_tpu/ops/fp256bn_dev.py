@@ -154,10 +154,6 @@ def f6_mul_v(x):
     return (f2_mul_xi(x[2]), x[0], x[1])
 
 
-def f6_sqr(x):
-    return f6_mul(x, x)
-
-
 def f6_inv(x):
     a0, a1, a2 = x
     t0 = f2_sub(f2_sqr(a0), f2_mul_xi(f2_mul(a1, a2)))

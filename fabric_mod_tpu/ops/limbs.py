@@ -222,13 +222,6 @@ def sb_sqr_full(a: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(jnp.stack(rows, axis=0), axis=0)
 
 
-def sb_mul_low(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Low K columns of the schoolbook product (i.e. a*b mod-ish R)."""
-    rows = [_pad_last(a[..., i:i + 1] * b[..., :K - i], i, 0)
-            for i in range(K)]
-    return jnp.sum(jnp.stack(rows, axis=0), axis=0)
-
-
 def carry_mod_r(x: jnp.ndarray) -> jnp.ndarray:
     """carry2 over exactly K limbs, dropping carries past limb K-1 (mod R)."""
     for _ in range(2):

@@ -79,15 +79,29 @@ class ChaincodeRegistry:
 
     def __init__(self):
         self._contracts: Dict[str, Contract] = {}
+        self._resolver: Optional[Callable[[str], Optional[Contract]]] = None
 
     def register(self, name: str, contract: Contract) -> None:
         self._contracts[name] = contract
 
+    def set_resolver(self, resolver) -> None:
+        """Miss handler (reference: the Launch-on-first-use path of
+        chaincode_support.go:93 — the ChaincodeLauncher plugs in
+        here).  A non-None result is cached; None is NOT, so a
+        chaincode installed later becomes resolvable — misses must
+        therefore be cheap (the launcher's miss is one listdir)."""
+        self._resolver = resolver
+
     def get(self, name: str) -> Optional[Contract]:
-        return self._contracts.get(name)
+        cc = self._contracts.get(name)
+        if cc is None and self._resolver is not None:
+            cc = self._resolver(name)
+            if cc is not None:
+                self._contracts[name] = cc
+        return cc
 
     def execute(self, name: str, stub: ChaincodeStub) -> bytes:
-        cc = self._contracts.get(name)
+        cc = self.get(name)
         if cc is None:
             raise ChaincodeError(f"chaincode {name!r} not installed")
         return cc.invoke(stub)

@@ -1,0 +1,467 @@
+"""External chaincode builders + chaincode-as-a-service.
+
+(reference: core/container/externalbuilder.go:428 — operator-supplied
+builder directories with bin/detect, bin/build, bin/release, bin/run
+executables run as subprocesses — and the chaincode-as-a-service
+pattern where the package's payload is a connection.json pointing at
+an ALREADY-RUNNING chaincode server the peer connects to as a client.)
+
+The TPU-native runtime keeps contracts host-side (chaincode is control
+plane, SURVEY §2.3); out-of-process execution uses a line-JSON
+protocol over TCP instead of the reference's gRPC shim stream, with
+the same callback shape: the peer drives `invoke`, the chaincode
+answers with state-operation requests (get/put/del/range/query,
+public + private) that the peer executes against the live transaction
+simulator, then `complete`/`error` ends the exchange.
+
+Three pieces:
+* `ChaincodeServer` — the service side: users run any `Contract`
+  out-of-process with `serve_forever()`.
+* `ExternalContract` — the peer-side adapter implementing the
+  Contract protocol over a connection.json address.
+* `ExternalBuilderRegistry` + `ChaincodeLauncher` — script-contract
+  builders (detect/build/release/run) and the resolver that turns an
+  installed package into a live Contract on first use ("python"
+  packages exec in-process; "ccaas" packages dial out).
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import socket
+import socketserver
+import subprocess
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from fabric_mod_tpu.peer.chaincode import ChaincodeError, ChaincodeStub
+
+
+class ExternalBuilderError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Wire protocol: newline-delimited JSON, bytes base64-encoded
+# ---------------------------------------------------------------------------
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+def _send(sock_file, obj: Dict) -> None:
+    sock_file.write(json.dumps(obj, sort_keys=True) + "\n")
+    sock_file.flush()
+
+
+def _recv(sock_file) -> Dict:
+    line = sock_file.readline()
+    if not line:
+        # transport-level: the exchange is dead, not a contract error
+        raise ConnectionError("chaincode connection closed")
+    return json.loads(line)
+
+
+# the state callbacks the protocol proxies (name -> stub driver)
+def _dispatch_state_op(stub: ChaincodeStub, msg: Dict) -> Dict:
+    op = msg.get("op")
+    if op == "get_state":
+        v = stub.get_state(msg["key"])
+        return {"value": _b64(v) if v is not None else None}
+    if op == "put_state":
+        stub.put_state(msg["key"], _unb64(msg["value"]))
+        return {}
+    if op == "del_state":
+        stub.del_state(msg["key"])
+        return {}
+    if op == "get_state_range":
+        out = [[k, _b64(v)] for k, v in
+               stub.get_state_range(msg["start"], msg["end"])]
+        return {"results": out}
+    if op == "get_query_result":
+        results, bookmark = stub.get_query_result(msg["query"])
+        return {"results": [[k, d] for k, d in results],
+                "bookmark": bookmark}
+    if op == "set_state_metadata":
+        stub.set_state_metadata(msg["key"], msg["name"],
+                                _unb64(msg["value"]))
+        return {}
+    if op == "put_private_data":
+        stub.put_private_data(msg["collection"], msg["key"],
+                              _unb64(msg["value"]))
+        return {}
+    if op == "get_private_data":
+        v = stub.get_private_data(msg["collection"], msg["key"])
+        return {"value": _b64(v) if v is not None else None}
+    if op == "del_private_data":
+        stub.del_private_data(msg["collection"], msg["key"])
+        return {}
+    raise ChaincodeError(f"unknown state op {op!r}")
+
+
+# ---------------------------------------------------------------------------
+# Service side (runs in the chaincode's own process)
+# ---------------------------------------------------------------------------
+
+class _ProxyStub:
+    """Looks like a ChaincodeStub to the remote contract; every state
+    call travels back to the peer over the live exchange."""
+
+    def __init__(self, sock_file, args: List[bytes],
+                 transient: Dict[str, bytes], txid: str):
+        self._f = sock_file
+        self.args = args
+        self.transient = transient
+        self.txid = txid
+
+    def _call(self, **msg) -> Dict:
+        _send(self._f, {"type": "state", **msg})
+        resp = _recv(self._f)
+        if resp.get("type") != "state_response":
+            raise ChaincodeError("protocol violation from peer")
+        if "error" in resp:
+            raise ChaincodeError(resp["error"])
+        return resp
+
+    def get_state(self, key: str) -> Optional[bytes]:
+        v = self._call(op="get_state", key=key).get("value")
+        return _unb64(v) if v is not None else None
+
+    def put_state(self, key: str, value: bytes) -> None:
+        self._call(op="put_state", key=key, value=_b64(value))
+
+    def del_state(self, key: str) -> None:
+        self._call(op="del_state", key=key)
+
+    def get_state_range(self, start: str, end: str):
+        out = self._call(op="get_state_range", start=start, end=end)
+        return iter([(k, _unb64(v)) for k, v in out["results"]])
+
+    def get_query_result(self, query):
+        if isinstance(query, bytes):
+            query = query.decode()
+        out = self._call(op="get_query_result", query=query)
+        return [(k, d) for k, d in out["results"]], out["bookmark"]
+
+    def set_state_metadata(self, key: str, name: str,
+                           value: bytes) -> None:
+        self._call(op="set_state_metadata", key=key, name=name,
+                   value=_b64(value))
+
+    def put_private_data(self, collection: str, key: str,
+                         value: bytes) -> None:
+        self._call(op="put_private_data", collection=collection,
+                   key=key, value=_b64(value))
+
+    def get_private_data(self, collection: str,
+                         key: str) -> Optional[bytes]:
+        v = self._call(op="get_private_data", collection=collection,
+                       key=key).get("value")
+        return _unb64(v) if v is not None else None
+
+    def del_private_data(self, collection: str, key: str) -> None:
+        self._call(op="del_private_data", collection=collection,
+                   key=key)
+
+
+class ChaincodeServer:
+    """Serves one Contract out-of-process (the CCaaS server —
+    reference: the peer.connects-to-chaincode mode of external
+    builders; here the protocol server the ExternalContract dials)."""
+
+    def __init__(self, contract, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._contract = contract
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self) -> None:
+                f = _SockFile(self.rfile, self.wfile)
+                while True:
+                    try:
+                        msg = _recv(f)
+                    except Exception:
+                        return
+                    if msg.get("type") != "invoke":
+                        return
+                    stub = _ProxyStub(
+                        f,
+                        [_unb64(a) for a in msg["args"]],
+                        {k: _unb64(v)
+                         for k, v in msg.get("transient", {}).items()},
+                        msg.get("txid", ""))
+                    try:
+                        payload = outer._contract.invoke(stub)
+                        _send(f, {"type": "complete",
+                                  "payload": _b64(payload or b"")})
+                    except Exception as e:
+                        _send(f, {"type": "error", "message": str(e)})
+
+        self._srv = socketserver.ThreadingTCPServer((host, port), Handler)
+        self._srv.daemon_threads = True
+        self.address = "%s:%d" % self._srv.server_address
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        t.start()
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class _SockFile:
+    """read/write adapter shared by both protocol ends."""
+
+    def __init__(self, rfile, wfile):
+        self._r = rfile
+        self._w = wfile
+
+    def readline(self) -> str:
+        line = self._r.readline()
+        return line.decode() if isinstance(line, bytes) else line
+
+    def write(self, s) -> None:
+        self._w.write(s.encode() if isinstance(s, str) else s)
+
+    def flush(self) -> None:
+        self._w.flush()
+
+
+# ---------------------------------------------------------------------------
+# Peer side
+# ---------------------------------------------------------------------------
+
+class ExternalContract:
+    """Contract adapter: forwards invoke() to a chaincode server named
+    by connection.json (reference: the ccaas connection.json contract
+    — {"address": "host:port"}).  One connection, invokes serialized
+    (the endorser already serializes per-proposal)."""
+
+    def __init__(self, connection: Dict, timeout_s: float = 30.0):
+        address = connection.get("address", "")
+        host, _, port = address.partition(":")
+        if not host or not port:
+            raise ExternalBuilderError(
+                f"connection.json address invalid: {address!r}")
+        self._addr = (host, int(port))
+        self._timeout = timeout_s
+        # RLock: the invoke error path closes the connection while
+        # already holding the lock
+        self._lock = threading.RLock()
+        self._sock: Optional[socket.socket] = None
+        self._file: Optional[_SockFile] = None
+
+    def _connect(self) -> _SockFile:
+        if self._file is None:
+            s = socket.create_connection(self._addr,
+                                         timeout=self._timeout)
+            self._sock = s
+            rf = s.makefile("rb")
+            wf = s.makefile("wb")
+            self._file = _SockFile(rf, wf)
+        return self._file
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+                    self._file = None
+
+    def invoke(self, stub: ChaincodeStub) -> bytes:
+        with self._lock:
+            try:
+                return self._invoke_locked(stub)
+            except ChaincodeError:
+                # the contract reported an error over a COMPLETED
+                # exchange: the connection stays usable
+                raise
+            except Exception as e:
+                # transport-level (EOF, refused, protocol violation):
+                # the socket may be dead or desynchronized mid-exchange
+                # — never reuse it for the next transaction
+                self.close()
+                raise ChaincodeError(
+                    f"external chaincode unreachable: {e}") from e
+
+    def _invoke_locked(self, stub: ChaincodeStub) -> bytes:
+        f = self._connect()
+        _send(f, {"type": "invoke", "txid": stub.txid,
+                  "args": [_b64(a) for a in stub.args],
+                  "transient": {k: _b64(v)
+                                for k, v in stub.transient.items()}})
+        while True:
+            msg = _recv(f)
+            kind = msg.get("type")
+            if kind == "state":
+                try:
+                    out = _dispatch_state_op(stub, msg)
+                    _send(f, {"type": "state_response", **out})
+                except Exception as e:
+                    _send(f, {"type": "state_response",
+                              "error": str(e)})
+            elif kind == "complete":
+                return _unb64(msg.get("payload", ""))
+            elif kind == "error":
+                raise ChaincodeError(msg.get("message", "chaincode error"))
+            else:
+                raise ConnectionError(f"protocol violation: {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# Script-contract builders (reference: externalbuilder.go detect/
+# build/release/run)
+# ---------------------------------------------------------------------------
+
+class ExternalBuilder:
+    """One builder directory with bin/{detect,build,release,run}.
+
+    detect(BUILD_OUTPUT_DIR=metadata dir) exit 0 claims the package;
+    build(SOURCE, METADATA, OUTPUT) materializes runnable output;
+    release(OUTPUT, RELEASE) exports artifacts; run(OUTPUT, RUN_META)
+    launches the chaincode (long-running subprocess)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.name = os.path.basename(path.rstrip("/"))
+
+    def _script(self, name: str) -> Optional[str]:
+        p = os.path.join(self.path, "bin", name)
+        return p if os.access(p, os.X_OK) else None
+
+    def _run(self, name: str, args: List[str],
+             timeout_s: float = 60.0) -> int:
+        script = self._script(name)
+        if script is None:
+            # detect and build are MANDATORY in the reference's
+            # contract; only release (and run, handled separately) are
+            # optional — a missing build must not silently "succeed"
+            if name == "detect":
+                return 1
+            if name == "build":
+                raise ExternalBuilderError(
+                    f"builder {self.name} has no bin/build")
+            return 0
+        proc = subprocess.run([script] + args, timeout=timeout_s,
+                              capture_output=True)
+        return proc.returncode
+
+    def detect(self, metadata_dir: str) -> bool:
+        return self._run("detect", [metadata_dir]) == 0
+
+    def build(self, source_dir: str, metadata_dir: str,
+              output_dir: str) -> None:
+        if self._run("build", [source_dir, metadata_dir,
+                               output_dir]) != 0:
+            raise ExternalBuilderError(f"builder {self.name}: build "
+                                       "failed")
+
+    def release(self, output_dir: str, release_dir: str) -> None:
+        if self._run("release", [output_dir, release_dir]) != 0:
+            raise ExternalBuilderError(f"builder {self.name}: release "
+                                       "failed")
+
+    def run(self, output_dir: str, run_meta_dir: str
+            ) -> subprocess.Popen:
+        script = self._script("run")
+        if script is None:
+            raise ExternalBuilderError(f"builder {self.name} has no "
+                                       "bin/run")
+        return subprocess.Popen([script, output_dir, run_meta_dir])
+
+
+class ExternalBuilderRegistry:
+    """Ordered builder list scanned from a root dir (reference: the
+    externalBuilders core.yaml section; first detect() wins)."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.builders: List[ExternalBuilder] = []
+        if root and os.path.isdir(root):
+            for name in sorted(os.listdir(root)):
+                p = os.path.join(root, name)
+                if os.path.isdir(p):
+                    self.builders.append(ExternalBuilder(p))
+
+    def detect(self, metadata_dir: str) -> Optional[ExternalBuilder]:
+        for b in self.builders:
+            if b.detect(metadata_dir):
+                return b
+        return None
+
+
+# ---------------------------------------------------------------------------
+# The launcher: installed package -> live Contract
+# ---------------------------------------------------------------------------
+
+class ChaincodeLauncher:
+    """Resolves a namespace to a Contract from the installed packages
+    on first use (reference: chaincode_support.go:93 Launch).  Wire it
+    as the ChaincodeRegistry's resolver.
+
+    Package types:
+    * "ccaas": code payload is connection.json — dial the running
+      chaincode server (no process management; reference ccaas).
+    * "python": code payload is a module source defining `contract`
+      (or a callable `invoke`) — exec'd in-process, the runtime's
+      native unit (ccpackage.py's documented distribution unit).
+    * anything else: offered to the external builders.
+    """
+
+    def __init__(self, package_store, builders=None):
+        self._store = package_store
+        self._builders = builders or ExternalBuilderRegistry()
+        self._live: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def resolve(self, name: str):
+        with self._lock:
+            if name in self._live:
+                return self._live[name]
+            contract = self._build(name)
+            if contract is not None:
+                self._live[name] = contract
+            return contract
+
+    def _find_package(self, name: str) -> Optional[Tuple[str, str, bytes]]:
+        from fabric_mod_tpu.peer.ccpackage import parse_package
+        for pkg_id in self._store.list():
+            label = pkg_id.partition(":")[0]
+            if label == name:
+                raw = self._store.load(pkg_id)
+                return parse_package(raw)
+        return None
+
+    def _build(self, name: str):
+        got = self._find_package(name)
+        if got is None:
+            return None
+        label, cc_type, code = got
+        if cc_type == "ccaas":
+            try:
+                conn = json.loads(code)
+            except Exception as e:
+                raise ExternalBuilderError(
+                    f"package {label}: bad connection.json: {e}") from e
+            return ExternalContract(conn)
+        if cc_type == "python":
+            ns: Dict = {}
+            exec(compile(code, f"<chaincode {label}>", "exec"), ns)
+            contract = ns.get("contract")
+            if contract is None and callable(ns.get("invoke")):
+                from fabric_mod_tpu.peer.chaincode import FuncContract
+                contract = FuncContract(ns["invoke"])
+            if contract is None:
+                raise ExternalBuilderError(
+                    f"package {label}: defines no `contract`")
+            return contract
+        raise ExternalBuilderError(
+            f"package {label}: no runtime for type {cc_type!r} "
+            "(external builders handle it via detect/build/run)")
