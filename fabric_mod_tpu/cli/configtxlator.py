@@ -1,0 +1,61 @@
+"""configtxlator: proto<->JSON translation + config update computation.
+
+(reference: internal/configtxlator — the proto_encode/proto_decode/
+compute_update commands (update/update.go); the REST router collapses
+to this CLI since the translation logic is library-first here.)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from fabric_mod_tpu.protos import jsonpb
+from fabric_mod_tpu.protos import messages as m
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fabric-mod-tpu configtxlator")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("proto_decode",
+                       help="wire bytes -> JSON on stdout")
+    p.add_argument("--type", required=True,
+                   help="message type name, e.g. Config, Block")
+    p.add_argument("--input", required=True)
+
+    p = sub.add_parser("proto_encode",
+                       help="JSON -> wire bytes")
+    p.add_argument("--type", required=True)
+    p.add_argument("--input", required=True)
+    p.add_argument("--output", required=True)
+
+    p = sub.add_parser("compute_update",
+                       help="delta between two Config protos")
+    p.add_argument("--channel_id", required=True)
+    p.add_argument("--original", required=True)
+    p.add_argument("--updated", required=True)
+    p.add_argument("--output", required=True)
+
+    args = ap.parse_args(argv)
+    if args.cmd == "proto_decode":
+        raw = open(args.input, "rb").read()
+        json.dump(jsonpb.proto_decode(args.type, raw), sys.stdout,
+                  indent=2, sort_keys=True)
+        print()
+        return 0
+    if args.cmd == "proto_encode":
+        data = json.load(open(args.input))
+        raw = jsonpb.proto_encode(args.type, data)
+        with open(args.output, "wb") as f:
+            f.write(raw)
+        return 0
+    if args.cmd == "compute_update":
+        from fabric_mod_tpu.channelconfig import compute_update
+        original = m.Config.decode(open(args.original, "rb").read())
+        updated = m.Config.decode(open(args.updated, "rb").read())
+        update = compute_update(args.channel_id, original, updated)
+        with open(args.output, "wb") as f:
+            f.write(update.encode())
+        return 0
+    return 2

@@ -1,0 +1,80 @@
+"""discover: query channel config + endorsement layouts.
+
+(reference: cmd/discover + discovery/cmd — the client CLI for the
+discovery service; peers/config/endorsers subcommands.  This tool
+builds the discovery view from a genesis/config block plus a
+membership JSON (org -> [{endpoint, mspid}]), i.e. the same inputs
+the in-process service reads from gossip.)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from fabric_mod_tpu.channelconfig import Bundle
+from fabric_mod_tpu.channelconfig.configtx import config_from_block
+from fabric_mod_tpu.protos import messages as m
+
+
+def _load_bundle(genesis_path: str):
+    from fabric_mod_tpu.bccsp.sw import SwCSP
+    block = m.Block.decode(open(genesis_path, "rb").read())
+    cid, config = config_from_block(block)
+    return cid, Bundle(cid, config, SwCSP())
+
+
+def _membership_fn(path):
+    members = {}
+    if path:
+        raw = json.load(open(path))
+        for org, eps in raw.items():
+            members[org] = [m.GossipMember(endpoint=e) for e in eps]
+    return lambda: members
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fabric-mod-tpu discover")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name in ("peers", "config", "endorsers"):
+        p = sub.add_parser(name)
+        p.add_argument("--genesis", required=True,
+                       help="channel genesis/config block file")
+        p.add_argument("--membership",
+                       help="JSON file: {org: [endpoint, ...]}")
+        if name == "endorsers":
+            p.add_argument("--chaincode", required=True)
+    args = ap.parse_args(argv)
+
+    from fabric_mod_tpu.discovery.service import DiscoveryService
+    cid, bundle = _load_bundle(args.genesis)
+
+    class _StaticVinfo:
+        def validation_info(self, ns):
+            return "builtin", m.ApplicationPolicy(
+                channel_config_policy_reference=
+                "/Channel/Application/Endorsement").encode()
+
+    svc = DiscoveryService(lambda: bundle, _StaticVinfo(),
+                           _membership_fn(args.membership))
+    if args.cmd == "peers":
+        out = {org: [mem.endpoint for mem in members]
+               for org, members in svc.peers().items()}
+        json.dump({"channel": cid, "peers": out}, sys.stdout, indent=2)
+    elif args.cmd == "config":
+        cfg = svc.config()
+        out = {"msps": {k: [c.decode() for c in v]
+                        for k, v in cfg["msps"].items()},
+               "orderers": cfg["orderers"]}
+        json.dump({"channel": cid, "config": out}, sys.stdout, indent=2)
+    else:
+        desc = svc.peers_for_endorsement(args.chaincode)
+        json.dump({"channel": cid, "chaincode": args.chaincode,
+                   "layouts": [dict(l.quantities_by_org)
+                               for l in desc.layouts],
+                   "peers_by_org": {
+                       org: [mem.endpoint for mem in members]
+                       for org, members in desc.peers_by_org.items()}},
+                  sys.stdout, indent=2)
+    print()
+    return 0

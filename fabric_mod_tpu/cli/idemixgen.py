@@ -1,0 +1,72 @@
+"""idemixgen: issuer key + anonymous credential generation.
+
+(reference: common/tools/idemixgen — ca-keygen writes the issuer key
+pair, signerconfig issues a credential for one signer; artifacts are
+the JSON wire forms the IdemixMsp consumes.)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from fabric_mod_tpu.idemix import credential as cred
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fabric-mod-tpu idemixgen")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("ca-keygen",
+                       help="generate an issuer key pair")
+    p.add_argument("--output", default="idemix-config")
+    p.add_argument("--attrs", default="OU,Role,EnrollmentID,RevocationHandle",
+                   help="comma-separated attribute names")
+
+    p = sub.add_parser("signerconfig",
+                       help="issue a credential for one signer")
+    p.add_argument("--ca-input", default="idemix-config")
+    p.add_argument("--output", default="idemix-config")
+    p.add_argument("--org-unit", default="")
+    p.add_argument("--enrollment-id", default="")
+    p.add_argument("--role", type=int, default=0)
+
+    args = ap.parse_args(argv)
+    if args.cmd == "ca-keygen":
+        names = [a for a in args.attrs.split(",") if a]
+        ik = cred.IssuerKey(names)
+        os.makedirs(args.output, exist_ok=True)
+        with open(os.path.join(args.output, "IssuerKey.json"), "w") as f:
+            json.dump(ik.to_dict(), f, indent=2, sort_keys=True)
+        with open(os.path.join(args.output,
+                               "IssuerPublicKey.json"), "w") as f:
+            json.dump(ik.public_dict(), f, indent=2, sort_keys=True)
+        print(f"issuer key written to {args.output}/")
+        return 0
+    if args.cmd == "signerconfig":
+        with open(os.path.join(args.ca_input, "IssuerKey.json")) as f:
+            ik = cred.IssuerKey.from_dict(json.load(f))
+        sk = cred._rand_zr()
+        attrs = []
+        for name in ik.attr_names:
+            if name == "OU":
+                attrs.append(cred._hash_to_zr(args.org_unit.encode()))
+            elif name == "Role":
+                attrs.append(args.role)
+            elif name == "EnrollmentID":
+                attrs.append(cred._hash_to_zr(
+                    args.enrollment_id.encode()))
+            else:
+                attrs.append(0)
+        c = cred.issue(ik, sk, attrs)
+        user_dir = os.path.join(args.output, "user")
+        os.makedirs(user_dir, exist_ok=True)
+        with open(os.path.join(user_dir, "SignerConfig.json"), "w") as f:
+            json.dump({"sk": hex(sk), "credential": c.to_dict(),
+                       "organizational_unit": args.org_unit,
+                       "enrollment_id": args.enrollment_id,
+                       "role": args.role},
+                      f, indent=2, sort_keys=True)
+        print(f"signer config written to {user_dir}/")
+        return 0
+    return 2

@@ -11,7 +11,8 @@ import sys
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv:
-        print("usage: fabric-mod-tpu {cryptogen|configtxgen|node|ledger} ...",
+        print("usage: fabric-mod-tpu {cryptogen|configtxgen|"
+              "configtxlator|idemixgen|discover|node|ledger} ...",
               file=sys.stderr)
         return 2
     tool, rest = argv[0], argv[1:]
@@ -19,6 +20,12 @@ def main(argv=None) -> int:
         from fabric_mod_tpu.cli.cryptogen import main as run
     elif tool == "configtxgen":
         from fabric_mod_tpu.cli.configtxgen import main as run
+    elif tool == "configtxlator":
+        from fabric_mod_tpu.cli.configtxlator import main as run
+    elif tool == "idemixgen":
+        from fabric_mod_tpu.cli.idemixgen import main as run
+    elif tool == "discover":
+        from fabric_mod_tpu.cli.discover import main as run
     elif tool == "node":
         from fabric_mod_tpu.cli.node import main as run
     elif tool == "ledger":

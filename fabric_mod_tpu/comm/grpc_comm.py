@@ -13,6 +13,8 @@ the L4 control plane; device batches never cross these sockets
 """
 from __future__ import annotations
 
+import threading
+import time
 from concurrent import futures
 from typing import Callable, Dict, Optional, Tuple
 
@@ -32,6 +34,76 @@ class MethodKind:
     UNARY = "unary"
     SERVER_STREAM = "server_stream"
     STREAM_STREAM = "stream_stream"
+
+
+# -- RPC observability (reference: common/grpcmetrics/interceptor.go +
+# -- common/grpclogging/server.go — every server handler is wrapped
+# -- with request counters, a duration histogram, and debug logs) -----------
+
+_rpc_metrics_lock = threading.Lock()
+_rpc_metrics = None
+
+
+def _get_rpc_metrics():
+    global _rpc_metrics
+    with _rpc_metrics_lock:
+        if _rpc_metrics is None:
+            from fabric_mod_tpu.observability.metrics import (
+                MetricOpts, default_provider)
+            prov = default_provider()
+            _rpc_metrics = (
+                prov.new_counter(MetricOpts(
+                    "grpc", "server", "requests_completed",
+                    "RPCs completed", ("service", "method", "code"))),
+                prov.new_histogram(MetricOpts(
+                    "grpc", "server", "request_duration_seconds",
+                    "RPC handling time", ("service", "method"))),
+            )
+        return _rpc_metrics
+
+
+def _observe(service: str, method: str, kind: str, fn):
+    """Wrap a handler with counters + duration (streams time the full
+    stream life, like the reference's stream interceptor)."""
+    from fabric_mod_tpu.observability.logging import get_logger
+    log = get_logger("comm.grpc")
+
+    def wrapped(request, context):
+        counter, hist = _get_rpc_metrics()
+        t0 = time.perf_counter()
+        code = "OK"
+        try:
+            result = fn(request, context)
+            if kind != MethodKind.UNARY:
+                # drain-through generator so the duration covers the
+                # whole stream, not just handler setup; a mid-stream
+                # raise must count as ERROR, not OK
+                def stream():
+                    scode = "OK"
+                    try:
+                        yield from result
+                    except BaseException:
+                        scode = "ERROR"
+                        raise
+                    finally:
+                        hist.with_labels(service, method).observe(
+                            time.perf_counter() - t0)
+                        counter.with_labels(service, method,
+                                            scode).add(1)
+                return stream()
+            return result
+        except Exception:
+            code = "ERROR"
+            raise
+        finally:
+            if kind == MethodKind.UNARY:
+                hist.with_labels(service, method).observe(
+                    time.perf_counter() - t0)
+                counter.with_labels(service, method, code).add(1)
+                log.debug("%s/%s -> %s", service, method, code)
+            elif code == "ERROR":
+                counter.with_labels(service, method, "ERROR").add(1)
+    return wrapped
 
 
 class GRPCServer:
@@ -65,6 +137,7 @@ class GRPCServer:
         for service, methods in self._services.items():
             rpcs = {}
             for name, (kind, fn) in methods.items():
+                fn = _observe(service, name, kind, fn)
                 if kind == MethodKind.UNARY:
                     rpcs[name] = grpc.unary_unary_rpc_method_handler(
                         fn, *_IDENT)

@@ -117,8 +117,62 @@ class IssuerKey:
         return self.pok_c == _hash_to_zr(
             _g2_bytes(self.g2), _g2_bytes(self.W), _g2_bytes(t))
 
+    # -- serialization (reference: the idemixgen artifact files) ---------
+    def public_dict(self) -> dict:
+        return {"attr_names": list(self.attr_names),
+                "W": _g2_bytes(self.W).hex(),
+                "pok_c": str(self.pok_c), "pok_z": str(self.pok_z)}
+
+    def to_dict(self) -> dict:
+        d = self.public_dict()
+        d["x"] = str(self.x)               # the issuer SECRET key
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "IssuerKey":
+        ik = cls.__new__(cls)
+        ik.attr_names = list(d["attr_names"])
+        # public-only artifacts have no secret: keep it None so
+        # issuing with a public key fails LOUDLY, not with unverifiable
+        # credentials
+        ik.x = int(d["x"]) if "x" in d else None
+        ik.g2 = bn.g2_generator()
+        ik.W = _g2_from_hex(d["W"])
+        ik.HSk = hash_to_g1(b"HSk")
+        ik.HRand = hash_to_g1(b"HRand")
+        ik.HAttrs = [hash_to_g1(b"HAttr" + n.encode())
+                     for n in ik.attr_names]
+        ik.pok_c = int(d["pok_c"])
+        ik.pok_z = int(d["pok_z"])
+        if not ik.check_pok():
+            raise IdemixError("issuer key PoK invalid")
+        return ik
+
 
 # --- Credential -------------------------------------------------------------
+
+def _g2_from_hex(hexs: str) -> Optional[G2]:
+    raw = bytes.fromhex(hexs)
+    if raw == b"\x00" * 128:
+        return None
+    vals = [int.from_bytes(raw[i:i + 32], "big") for i in range(0, 128, 32)]
+    from fabric_mod_tpu.idemix.fp256bn import Fp2
+    q = G2(Fp2(vals[0], vals[1]), Fp2(vals[2], vals[3]))
+    if not q.is_on_curve():
+        raise IdemixError("G2 point not on the twist")
+    return q
+
+
+def _g1_from_hex(hexs: str) -> Optional[G1]:
+    raw = bytes.fromhex(hexs)
+    if raw == b"\x00" * 64:
+        return None
+    p = G1(int.from_bytes(raw[:32], "big"),
+           int.from_bytes(raw[32:], "big"))
+    if not p.is_on_curve():
+        raise IdemixError("G1 point not on the curve")
+    return p
+
 
 class Credential:
     def __init__(self, A: G1, B: G1, e: int, s: int,
@@ -126,12 +180,26 @@ class Credential:
         self.A, self.B, self.e, self.s = A, B, e, s
         self.attrs = list(attrs)
 
+    def to_dict(self) -> dict:
+        return {"A": _g1_bytes(self.A).hex(), "B": _g1_bytes(self.B).hex(),
+                "e": str(self.e), "s": str(self.s),
+                "attrs": [str(a) for a in self.attrs]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Credential":
+        return cls(_g1_from_hex(d["A"]), _g1_from_hex(d["B"]),
+                   int(d["e"]), int(d["s"]),
+                   [int(a) for a in d["attrs"]])
+
 
 def issue(ik: IssuerKey, sk: int, attrs: Sequence[int]) -> Credential:
     """(reference: idemix/credential.go NewCredential — collapsed
     issuance: the blinded-request round trip is protocol plumbing)"""
     if len(attrs) != len(ik.HAttrs):
         raise IdemixError("attribute count mismatch")
+    if ik.x is None:
+        raise IdemixError("issuer key is public-only; issuing needs "
+                          "the secret key")
     e, s = _rand_zr(), _rand_zr()
     B = g1_add(G1.generator(), g1_mul(sk, ik.HSk))
     B = g1_add(B, g1_mul(s, ik.HRand))

@@ -1,0 +1,176 @@
+"""Chaincode lifecycle events + historical collection configs.
+
+(reference: core/ledger/cceventmgmt/mgr.go — listeners fired when a
+chaincode definition commits, used e.g. to create state-DB indexes —
+and core/ledger/confighistory/mgr.go — the retriever answering "what
+was this chaincode's collection config as of block N", which private
+data reconciliation needs when configs changed since the data's
+block.)
+
+One module covers both because they watch the same signal: committed
+writes to the lifecycle namespace.  KvLedger calls
+`handle_block_writes` from its commit AND recovery-replay paths, so
+the file-backed history self-heals from the block store the same way
+state does; records are idempotent per (block, namespace).
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from fabric_mod_tpu.protos import messages as m
+
+LIFECYCLE_NS = "_lifecycle"
+
+
+class ChaincodeDefinitionEvent:
+    """What listeners receive (reference: cceventmgmt's
+    ChaincodeDefinition + deploy callback)."""
+
+    __slots__ = ("name", "version", "sequence", "collections",
+                 "block_num")
+
+    def __init__(self, name: str, version: str, sequence: int,
+                 collections: bytes, block_num: int):
+        self.name = name
+        self.version = version
+        self.sequence = sequence
+        self.collections = collections
+        self.block_num = block_num
+
+
+class ConfigHistoryManager:
+    """Records every committed (block, chaincode, collection-config)
+    and answers most-recent-below queries; append-only JSONL file so
+    reopen is O(history), not O(chain).
+
+    (reference: confighistory/mgr.go — the compositeKV store keyed by
+    (ns, blockNum) with reverse scans.)"""
+
+    def __init__(self, path: Optional[str] = None):
+        self._path = path
+        self._lock = threading.Lock()
+        # ns -> sorted [(block_num, collections bytes)]
+        self._by_ns: Dict[str, List[Tuple[int, bytes]]] = {}
+        self._listeners: List[Callable] = []
+        # last block OFFERED (not merely recorded): the ledger's
+        # recovery floor — blocks above it must be replayed through
+        # handle_block_writes or definitions would be lost to a crash
+        # between state commit and our write
+        self.savepoint = -1
+        if path and os.path.exists(path):
+            good_end = 0
+            last_block = -1
+            with open(path, "rb") as f:
+                data = f.read()
+            for line in data.splitlines(keepends=True):
+                try:
+                    rec = json.loads(line)
+                    self._insert(rec["ns"], rec["block"],
+                                 base64.b64decode(rec["collections"]))
+                    last_block = max(last_block, rec["block"])
+                except Exception:
+                    break                  # torn tail: crop below
+                good_end += len(line)
+            if good_end < len(data):
+                with open(path, "r+b") as f:
+                    f.truncate(good_end)
+            sp_path = path + ".sp"
+            sp = -1
+            if os.path.exists(sp_path):
+                try:
+                    sp = int(open(sp_path).read())
+                except Exception:
+                    sp = -1
+            # a torn record invalidates the persisted savepoint: fall
+            # back to the last intact record so recovery re-offers the
+            # rest of the chain
+            self.savepoint = (min(sp, last_block)
+                              if good_end < len(data) else sp)
+
+    # -- listeners (reference: cceventmgmt.Register) ----------------------
+    def register_listener(self, cb: Callable) -> None:
+        """cb(ChaincodeDefinitionEvent) fires on every committed
+        definition (deploy/upgrade)."""
+        self._listeners.append(cb)
+
+    # -- ingestion --------------------------------------------------------
+    def _insert(self, ns: str, block_num: int, collections: bytes) -> None:
+        lst = self._by_ns.setdefault(ns, [])
+        if lst and lst[-1][0] == block_num:
+            lst[-1] = (block_num, collections)
+        else:
+            lst.append((block_num, collections))
+
+    def handle_block_writes(self, block_num: int,
+                            writes: List[Tuple[str, str, Optional[bytes]]]
+                            ) -> None:
+        """Scan one committed block's (ns, key, value) writes for
+        lifecycle definitions; record configs + fire listeners."""
+        events = []
+        with self._lock:
+            if block_num <= self.savepoint:
+                return                     # replay of an offered block
+            for ns, key, value in writes:
+                if ns != LIFECYCLE_NS or value is None:
+                    continue
+                if not key.startswith("namespaces/") or "/" in \
+                        key[len("namespaces/"):]:
+                    continue               # only the definition records
+                cc_name = key[len("namespaces/"):]
+                try:
+                    d = m.ChaincodeDefinition.decode(value)
+                except Exception:
+                    continue
+                known = self._by_ns.get(cc_name, [])
+                if known and known[-1][0] >= block_num:
+                    continue               # replay of a recorded block
+                self._insert(cc_name, block_num, d.collections)
+                if self._path:
+                    with open(self._path, "a") as f:
+                        f.write(json.dumps({
+                            "ns": cc_name, "block": block_num,
+                            "collections": base64.b64encode(
+                                d.collections).decode()}) + "\n")
+                events.append(ChaincodeDefinitionEvent(
+                    cc_name, d.version, d.sequence, d.collections,
+                    block_num))
+            self.savepoint = block_num
+            if self._path:
+                tmp = self._path + ".sp.tmp"
+                with open(tmp, "w") as f:
+                    f.write(str(block_num))
+                os.replace(tmp, self._path + ".sp")
+        for ev in events:
+            for cb in self._listeners:
+                try:
+                    cb(ev)
+                except Exception:
+                    pass                   # listeners must not wedge commit
+
+    # -- queries (reference: confighistory retriever) --------------------
+    def most_recent_collection_config_below(
+            self, ns: str, block_num: int
+            ) -> Optional[Tuple[int, m.CollectionConfigPackage]]:
+        """The collection config in force for data written at
+        `block_num`: the newest definition committed STRICTLY below
+        it.  None when no definition predates the block."""
+        with self._lock:
+            lst = self._by_ns.get(ns, [])
+            for bn, raw in reversed(lst):
+                if bn < block_num:
+                    if not raw:
+                        return None
+                    try:
+                        return bn, m.CollectionConfigPackage.decode(raw)
+                    except Exception:
+                        return None
+        return None
+
+    def collection_config_history(self, ns: str
+                                  ) -> List[Tuple[int, bytes]]:
+        with self._lock:
+            return list(self._by_ns.get(ns, []))

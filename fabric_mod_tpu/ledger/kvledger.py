@@ -248,6 +248,13 @@ class KvLedger:
         self._transient = None
         self._pvtstore = None
         self._btl_fn = None
+        # lifecycle deploy events + historical collection configs
+        # (reference: cceventmgmt + confighistory) — file-backed, fed
+        # by both commit and recovery replay below
+        from fabric_mod_tpu.ledger.confighistory import (
+            ConfigHistoryManager)
+        self.confighistory = ConfigHistoryManager(
+            os.path.join(ledger_dir, "confighistory.jsonl"))
         self._recover()
 
     def attach_pvt(self, transient_store, pvtdata_store,
@@ -288,7 +295,11 @@ class KvLedger:
             self.history = DurableHistoryDB(
                 os.path.join(self.dir, "history"))
             hist_sp = -1
-        start = min(self.state.savepoint, hist_sp) + 1
+        # confighistory writes AFTER state commit, so its savepoint can
+        # trail state's by one block after a crash: include it in the
+        # replay floor (commit/replay are idempotent per store)
+        start = min(self.state.savepoint, hist_sp,
+                    self.confighistory.savepoint) + 1
         for block in self.blockstore.iter_blocks(max(0, start)):
             num = block.header.number
             replay_state = num > self.state.savepoint
@@ -323,6 +334,9 @@ class KvLedger:
         if replay_state:
             self.state.apply_updates(batch, num)
         self.history.commit(num, hist)
+        self.confighistory.handle_block_writes(
+            num, [(ns, key, value)
+                  for (ns, key), (value, _v) in batch.updates.items()])
 
     # -- simulation ------------------------------------------------------
     def new_tx_simulator(self, txid: str) -> TxSimulator:
@@ -380,6 +394,9 @@ class KvLedger:
                 # recovery replay record identical history
                 self.history.commit(num, tx_writes)
                 self._commit_pvt(num, txs, flags)
+                self.confighistory.handle_block_writes(
+                    num, [(ns, key, value) for (ns, key), (value, _v)
+                          in batch.updates.items()])
             G_HEIGHT.with_labels(self.ledger_id).set(
                 self.blockstore.height)
             if not self._durable and (num + 1) % self.SNAPSHOT_EVERY == 0:

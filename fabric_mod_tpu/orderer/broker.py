@@ -1,0 +1,250 @@
+"""Broker-based consenter: ordering via a shared append-only topic.
+
+(reference: orderer/consensus/kafka — chain.go:1181: every orderer
+posts envelopes to one partition and consumes the SAME offset-ordered
+stream, so all nodes cut identical blocks; batch timeouts are made
+deterministic with time-to-cut (TTC) messages — the first TTC naming
+a block number wins, duplicates are ignored; the last consumed offset
+rides in block metadata so restarts resume mid-stream without
+re-cutting (LAST_OFFSET_PERSISTED).)
+
+The broker here is the pluggable transport seam: an in-process
+`Broker` with optional CRC-framed file persistence stands in for the
+kafka cluster (same API shape a real broker client would adapt to).
+Determinism comes from the stream, not the broker: any transport that
+delivers the same messages in the same order to every consumer works.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from fabric_mod_tpu.orderer.consensus import ChainHaltedError
+from fabric_mod_tpu.protos import messages as m
+
+_NORMAL, _CONFIG, _TTC = 0, 1, 2
+
+
+class Broker:
+    """Offset-ordered topics (reference: the kafka partition).  With
+    `dir_path`, messages persist across restarts (CRC-framed; torn
+    tails cropped)."""
+
+    def __init__(self, dir_path: Optional[str] = None):
+        self._dir = dir_path
+        self._topics: Dict[str, List[bytes]] = {}
+        self._files: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        if dir_path:
+            os.makedirs(dir_path, exist_ok=True)
+            for name in sorted(os.listdir(dir_path)):
+                if name.endswith(".topic"):
+                    self._load(name[:-len(".topic")])
+
+    def _load(self, topic: str) -> None:
+        path = os.path.join(self._dir, topic + ".topic")
+        msgs: List[bytes] = []
+        raw = open(path, "rb").read()
+        pos = good = 0
+        while pos + 8 <= len(raw):
+            ln, crc = struct.unpack_from("<II", raw, pos)
+            end = pos + 8 + ln
+            if end > len(raw) or zlib.crc32(raw[pos + 8:end]) != crc:
+                break
+            msgs.append(raw[pos + 8:end])
+            good = pos = end
+        if good < len(raw):
+            with open(path, "r+b") as f:
+                f.truncate(good)
+        self._topics[topic] = msgs
+
+    def append(self, topic: str, msg: bytes) -> int:
+        """-> the assigned offset."""
+        with self._cv:
+            msgs = self._topics.setdefault(topic, [])
+            if self._dir:
+                f = self._files.get(topic)
+                if f is None:
+                    f = open(os.path.join(self._dir, topic + ".topic"),
+                             "ab")
+                    self._files[topic] = f
+                f.write(struct.pack("<II", len(msg), zlib.crc32(msg))
+                        + msg)
+                f.flush()
+                os.fsync(f.fileno())
+            msgs.append(msg)
+            self._cv.notify_all()
+            return len(msgs) - 1
+
+    def read(self, topic: str, from_offset: int,
+             timeout_s: float = 0.2) -> List[Tuple[int, bytes]]:
+        """Messages at offsets >= from_offset; blocks briefly when
+        none are available (the consumer poll)."""
+        with self._cv:
+            msgs = self._topics.get(topic, [])
+            if from_offset >= len(msgs):
+                self._cv.wait(timeout_s)
+                msgs = self._topics.get(topic, [])
+            return [(i, msgs[i])
+                    for i in range(from_offset, len(msgs))]
+
+    def close(self) -> None:
+        with self._lock:
+            for f in self._files.values():
+                f.close()
+            self._files.clear()
+
+
+def _encode(kind: int, payload: bytes, number: int = 0) -> bytes:
+    return bytes([kind]) + struct.pack("<q", number) + payload
+
+
+def _decode(raw: bytes) -> Tuple[int, int, bytes]:
+    return raw[0], struct.unpack_from("<q", raw, 1)[0], raw[9:]
+
+
+class BrokerChain:
+    """Consenter over a Broker topic (reference: kafka chain.go:1181).
+
+    All ordering decisions derive from the shared stream: size cuts
+    from message counts, timeout cuts from the first TTC naming the
+    next block number.  Every consumer builds identical blocks."""
+
+    OFFSET_MD_SLOT = 4                   # reference: LAST_OFFSET_PERSISTED
+
+    def __init__(self, broker: Broker, support,
+                 topic: Optional[str] = None):
+        self._broker = broker
+        self._support = support
+        self._topic = topic or support.channel_id
+        self._halted = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._timer_lock = threading.Lock()
+        self._timer: Optional[threading.Timer] = None
+        # resume: the offset recorded in the tip block's metadata
+        self._consumed = 0
+        store = support.store
+        if store.height > 1:
+            tip = store.get_block_by_number(store.height - 1)
+            md = tip.metadata.metadata if tip.metadata else []
+            if len(md) > self.OFFSET_MD_SLOT and md[self.OFFSET_MD_SLOT]:
+                self._consumed = struct.unpack(
+                    "<q", md[self.OFFSET_MD_SLOT])[0] + 1
+
+    # -- consenter surface ------------------------------------------------
+    def start(self) -> None:
+        self._thread.start()
+
+    def halt(self) -> None:
+        self._halted.set()
+        with self._timer_lock:
+            if self._timer is not None:
+                self._timer.cancel()
+        self._thread.join(timeout=5)
+
+    def wait_ready(self) -> None:
+        if self._halted.is_set():
+            raise ChainHaltedError("chain is halted")
+
+    def order(self, env: m.Envelope, config_seq: int) -> None:
+        self.wait_ready()
+        self._broker.append(self._topic,
+                            _encode(_NORMAL, env.encode(), config_seq))
+
+    def configure(self, env: m.Envelope, config_seq: int) -> None:
+        self.wait_ready()
+        self._broker.append(self._topic,
+                            _encode(_CONFIG, env.encode(), config_seq))
+
+    # -- timeout -> TTC (reference: sendTimeToCut) ------------------------
+    def _arm_timer(self, next_block: int) -> None:
+        with self._timer_lock:
+            if self._timer is not None or self._halted.is_set():
+                return
+
+            def fire():
+                with self._timer_lock:
+                    self._timer = None
+                if not self._halted.is_set():
+                    self._broker.append(self._topic,
+                                        _encode(_TTC, b"", next_block))
+            self._timer = threading.Timer(
+                self._support.batch_timeout_s(), fire)
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _disarm_timer(self) -> None:
+        with self._timer_lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+
+    # -- the consume loop -------------------------------------------------
+    def _write(self, batch, offset: int, is_config: bool = False,
+               config_env: Optional[m.Envelope] = None) -> None:
+        support = self._support
+        block = support.writer.create_next_block(batch)
+        md = block.metadata.metadata
+        while len(md) <= self.OFFSET_MD_SLOT:
+            md.append(b"")
+        md[self.OFFSET_MD_SLOT] = struct.pack("<q", offset)
+        if is_config:
+            support.process_config(config_env, block)
+        else:
+            support.writer.write_block(block)
+
+    def _run(self) -> None:
+        support = self._support
+        while not self._halted.is_set():
+            msgs = self._broker.read(self._topic, self._consumed)
+            for offset, raw in msgs:
+                if self._halted.is_set():
+                    return
+                kind, number, payload = _decode(raw)
+                if kind == _TTC:
+                    # first TTC for the CURRENT next block cuts; stale
+                    # duplicates (earlier numbers) are ignored
+                    if number == support.store.height:
+                        batch = support.cutter.cut()
+                        if batch:
+                            self._disarm_timer()
+                            self._write(batch, offset)
+                    self._consumed = offset + 1
+                    continue
+                try:
+                    env = m.Envelope.decode(payload)
+                except Exception:
+                    self._consumed = offset + 1
+                    continue
+                if kind == _CONFIG:
+                    if number < support.sequence():
+                        try:
+                            env, _cfg, _seq = support.reprocess_config(env)
+                        except Exception:
+                            self._consumed = offset + 1
+                            continue
+                    pending = support.cutter.cut()
+                    if pending:
+                        self._disarm_timer()
+                        self._write(pending, offset)
+                    self._write([env], offset, is_config=True,
+                                config_env=env)
+                    self._consumed = offset + 1
+                    continue
+                if number < support.sequence():
+                    try:
+                        support.revalidate_normal(env)
+                    except Exception:
+                        self._consumed = offset + 1
+                        continue
+                batches, pending = support.cutter.ordered(env)
+                for batch in batches:
+                    self._disarm_timer()
+                    self._write(batch, offset)
+                if pending:
+                    self._arm_timer(support.store.height)
+                self._consumed = offset + 1

@@ -30,10 +30,17 @@ class Endorser:
     """One peer's endorsement service for one channel."""
 
     def __init__(self, channel: Channel, registry: ChaincodeRegistry,
-                 signer):
+                 signer, max_concurrency: int = 0):
+        """`max_concurrency` > 0 caps in-flight ProcessProposal calls
+        (reference: internal/peer/node/grpc_limiters.go's Endorser
+        semaphore); excess requests shed after a short wait."""
         self._channel = channel
         self._registry = registry
         self._signer = signer
+        self._limiter = None
+        if max_concurrency > 0:
+            from fabric_mod_tpu.utils.semaphore import Semaphore
+            self._limiter = Semaphore(max_concurrency)
 
     # -- request preprocessing (reference: endorser.go:258 preProcess) --
     def _pre_process(self, sp: m.SignedProposal):
@@ -80,6 +87,17 @@ class Endorser:
 
     # -- the endorsement flow (reference: endorser.go:306) ---------------
     def process_proposal(self, sp: m.SignedProposal) -> m.ProposalResponse:
+        if self._limiter is not None:
+            from fabric_mod_tpu.utils.semaphore import AcquireTimeout
+            try:
+                with self._limiter.acquire(timeout_s=5.0):
+                    return self._process_proposal(sp)
+            except AcquireTimeout as e:
+                return m.ProposalResponse(response=m.Response(
+                    status=503, message=f"endorser overloaded: {e}"))
+        return self._process_proposal(sp)
+
+    def _process_proposal(self, sp: m.SignedProposal) -> m.ProposalResponse:
         prop, ch, sh = self._pre_process(sp)
         try:
             ccpp = m.ChaincodeProposalPayload.decode(prop.payload)

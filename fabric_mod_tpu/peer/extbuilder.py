@@ -113,11 +113,15 @@ class _ProxyStub:
     call travels back to the peer over the live exchange."""
 
     def __init__(self, sock_file, args: List[bytes],
-                 transient: Dict[str, bytes], txid: str):
+                 transient: Dict[str, bytes], txid: str,
+                 namespace: str = "", channel_id: str = ""):
         self._f = sock_file
         self.args = args
         self.transient = transient
         self.txid = txid
+        # same public surface as ChaincodeStub: contracts read these
+        self.namespace = namespace
+        self.channel_id = channel_id
 
     def _call(self, **msg) -> Dict:
         _send(self._f, {"type": "state", **msg})
@@ -194,7 +198,9 @@ class ChaincodeServer:
                         [_unb64(a) for a in msg["args"]],
                         {k: _unb64(v)
                          for k, v in msg.get("transient", {}).items()},
-                        msg.get("txid", ""))
+                        msg.get("txid", ""),
+                        namespace=msg.get("namespace", ""),
+                        channel_id=msg.get("channel_id", ""))
                     try:
                         payload = outer._contract.invoke(stub)
                         _send(f, {"type": "complete",
@@ -295,6 +301,8 @@ class ExternalContract:
     def _invoke_locked(self, stub: ChaincodeStub) -> bytes:
         f = self._connect()
         _send(f, {"type": "invoke", "txid": stub.txid,
+                  "namespace": getattr(stub, "namespace", ""),
+                  "channel_id": getattr(stub, "channel_id", ""),
                   "args": [_b64(a) for a in stub.args],
                   "transient": {k: _b64(v)
                                 for k, v in stub.transient.items()}})
@@ -338,36 +346,45 @@ class ExternalBuilder:
         return p if os.access(p, os.X_OK) else None
 
     def _run(self, name: str, args: List[str],
-             timeout_s: float = 60.0) -> int:
+             timeout_s: float = 60.0) -> Tuple[int, bytes]:
+        """-> (returncode, stderr).  A hung script counts as failure
+        (rc 1), never an escaping TimeoutExpired."""
         script = self._script(name)
         if script is None:
             # detect and build are MANDATORY in the reference's
             # contract; only release (and run, handled separately) are
             # optional — a missing build must not silently "succeed"
             if name == "detect":
-                return 1
+                return 1, b""
             if name == "build":
                 raise ExternalBuilderError(
                     f"builder {self.name} has no bin/build")
-            return 0
-        proc = subprocess.run([script] + args, timeout=timeout_s,
-                              capture_output=True)
-        return proc.returncode
+            return 0, b""
+        try:
+            proc = subprocess.run([script] + args, timeout=timeout_s,
+                                  capture_output=True)
+        except subprocess.TimeoutExpired:
+            return 1, b"timed out after %ds" % int(timeout_s)
+        return proc.returncode, proc.stderr or b""
 
     def detect(self, metadata_dir: str) -> bool:
-        return self._run("detect", [metadata_dir]) == 0
+        return self._run("detect", [metadata_dir])[0] == 0
 
     def build(self, source_dir: str, metadata_dir: str,
               output_dir: str) -> None:
-        if self._run("build", [source_dir, metadata_dir,
-                               output_dir]) != 0:
-            raise ExternalBuilderError(f"builder {self.name}: build "
-                                       "failed")
+        rc, stderr = self._run("build", [source_dir, metadata_dir,
+                                         output_dir])
+        if rc != 0:
+            raise ExternalBuilderError(
+                f"builder {self.name}: build failed: "
+                f"{stderr[-500:].decode(errors='replace')}")
 
     def release(self, output_dir: str, release_dir: str) -> None:
-        if self._run("release", [output_dir, release_dir]) != 0:
-            raise ExternalBuilderError(f"builder {self.name}: release "
-                                       "failed")
+        rc, stderr = self._run("release", [output_dir, release_dir])
+        if rc != 0:
+            raise ExternalBuilderError(
+                f"builder {self.name}: release failed: "
+                f"{stderr[-500:].decode(errors='replace')}")
 
     def run(self, output_dir: str, run_meta_dir: str
             ) -> subprocess.Popen:
@@ -419,6 +436,7 @@ class ChaincodeLauncher:
         self._store = package_store
         self._builders = builders or ExternalBuilderRegistry()
         self._live: Dict[str, object] = {}
+        self._procs: List[subprocess.Popen] = []
         self._lock = threading.Lock()
 
     def resolve(self, name: str):
@@ -432,12 +450,19 @@ class ChaincodeLauncher:
 
     def _find_package(self, name: str) -> Optional[Tuple[str, str, bytes]]:
         from fabric_mod_tpu.peer.ccpackage import parse_package
-        for pkg_id in self._store.list():
-            label = pkg_id.partition(":")[0]
-            if label == name:
-                raw = self._store.load(pkg_id)
-                return parse_package(raw)
-        return None
+        matches = sorted(pid for pid in self._store.list()
+                         if pid.partition(":")[0] == name)
+        if not matches:
+            return None
+        if len(matches) > 1:
+            # two installs sharing a label must not resolve by listdir
+            # luck — peers would run different code for the same name
+            raise ExternalBuilderError(
+                f"ambiguous chaincode {name!r}: {len(matches)} "
+                f"installed packages share the label ({matches}); "
+                "remove the stale install")
+        raw = self._store.load(matches[0])
+        return parse_package(raw)
 
     def _build(self, name: str):
         got = self._find_package(name)
@@ -462,6 +487,60 @@ class ChaincodeLauncher:
                 raise ExternalBuilderError(
                     f"package {label}: defines no `contract`")
             return contract
+        return self._build_external(label, cc_type, code)
+
+    def _build_external(self, label: str, cc_type: str, code: bytes):
+        """Offer an unknown package type to the external builders:
+        detect -> build -> release; the artifacts must yield a
+        connection.json (directly, via release, or written by a
+        launched bin/run — which receives the address file path in
+        its run metadata)."""
+        import tempfile
+        import time as _time
+        src = tempfile.mkdtemp(prefix=f"ccsrc-{label}-")
+        meta = tempfile.mkdtemp(prefix=f"ccmeta-{label}-")
+        out = tempfile.mkdtemp(prefix=f"ccout-{label}-")
+        rel = tempfile.mkdtemp(prefix=f"ccrel-{label}-")
+        with open(os.path.join(src, "code.bin"), "wb") as f:
+            f.write(code)
+        with open(os.path.join(meta, "metadata.json"), "w") as f:
+            json.dump({"label": label, "type": cc_type}, f)
+        builder = self._builders.detect(meta)
+        if builder is None:
+            raise ExternalBuilderError(
+                f"package {label}: no builder claims type {cc_type!r}")
+        builder.build(src, meta, out)
+        builder.release(out, rel)
+        for d in (rel, out):
+            conn_path = os.path.join(d, "connection.json")
+            if os.path.exists(conn_path):
+                return ExternalContract(json.load(open(conn_path)))
+        # no connection artifact: launch bin/run, which must write its
+        # listen address to the advertised file
+        run_meta = tempfile.mkdtemp(prefix=f"ccrun-{label}-")
+        addr_file = os.path.join(run_meta, "address")
+        with open(os.path.join(run_meta, "chaincode.json"), "w") as f:
+            json.dump({"address_file": addr_file}, f)
+        proc = builder.run(out, run_meta)
+        self._procs.append(proc)
+        deadline = _time.monotonic() + 30.0
+        while _time.monotonic() < deadline:
+            if os.path.exists(addr_file):
+                addr = open(addr_file).read().strip()
+                if addr:
+                    return ExternalContract({"address": addr})
+            if proc.poll() is not None:
+                raise ExternalBuilderError(
+                    f"builder {builder.name}: run exited rc="
+                    f"{proc.returncode} before publishing an address")
+            _time.sleep(0.05)
+        proc.kill()
         raise ExternalBuilderError(
-            f"package {label}: no runtime for type {cc_type!r} "
-            "(external builders handle it via detect/build/run)")
+            f"builder {builder.name}: run never published an address")
+
+    def close(self) -> None:
+        """Stop launched chaincode processes."""
+        for proc in self._procs:
+            if proc.poll() is None:
+                proc.kill()
+        self._procs.clear()

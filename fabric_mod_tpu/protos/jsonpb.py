@@ -1,0 +1,102 @@
+"""Generic Msg <-> JSON translation (the configtxlator surface).
+
+(reference: internal/configtxlator — protolator's proto<->JSON
+round-trip used by `configtxlator proto_encode/proto_decode`.  Our
+wire layer's FIELDS metadata plays protolator's reflection role.)
+
+Bytes fields are base64 strings; sub-messages are nested objects;
+repeated fields are arrays.  Fields at their default are omitted on
+encode and defaulted on decode, so the round-trip is stable.
+"""
+from __future__ import annotations
+
+import base64
+from typing import Any, Dict, Type
+
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos.wire import Msg, _REGISTRY
+
+
+class JsonPbError(Exception):
+    pass
+
+
+def _resolve(kind) -> Type[Msg]:
+    name = kind[1]
+    if name not in _REGISTRY:
+        raise JsonPbError(f"unknown message type {name!r}")
+    return _REGISTRY[name]
+
+
+def to_json(msg: Msg) -> Dict[str, Any]:
+    """Msg -> plain JSON-serializable dict."""
+    out: Dict[str, Any] = {}
+    for _num, attr, kind in msg.FIELDS:
+        val = getattr(msg, attr)
+        if isinstance(kind, list):
+            if not val:
+                continue
+            inner = kind[0]
+            if isinstance(inner, tuple):
+                out[attr] = [to_json(v) for v in val]
+            elif inner == "b":
+                out[attr] = [base64.b64encode(v).decode() for v in val]
+            else:
+                out[attr] = list(val)
+        elif isinstance(kind, tuple):
+            if val is not None:
+                out[attr] = to_json(val)
+        elif kind == "b":
+            if val:
+                out[attr] = base64.b64encode(val).decode()
+        elif kind == "s":
+            if val:
+                out[attr] = val
+        else:                              # "u" / "i"
+            if val:
+                out[attr] = val
+    return out
+
+
+def from_json(cls_or_name, data: Dict[str, Any]) -> Msg:
+    """JSON dict -> Msg instance of `cls_or_name`."""
+    cls = (_REGISTRY[cls_or_name] if isinstance(cls_or_name, str)
+           else cls_or_name)
+    kwargs: Dict[str, Any] = {}
+    known = {attr for _n, attr, _k in cls.FIELDS}
+    for key in data:
+        if key not in known:
+            raise JsonPbError(
+                f"{cls.__name__} has no field {key!r}")
+    for _num, attr, kind in cls.FIELDS:
+        if attr not in data:
+            continue
+        val = data[attr]
+        if isinstance(kind, list):
+            inner = kind[0]
+            if isinstance(inner, tuple):
+                kwargs[attr] = [from_json(_resolve(inner), v)
+                                for v in val]
+            elif inner == "b":
+                kwargs[attr] = [base64.b64decode(v) for v in val]
+            else:
+                kwargs[attr] = list(val)
+        elif isinstance(kind, tuple):
+            kwargs[attr] = from_json(_resolve(kind), val)
+        elif kind == "b":
+            kwargs[attr] = base64.b64decode(val)
+        else:
+            kwargs[attr] = val
+    return cls(**kwargs)
+
+
+def proto_decode(type_name: str, raw: bytes) -> Dict[str, Any]:
+    """Wire bytes -> JSON (configtxlator proto_decode)."""
+    if type_name not in _REGISTRY:
+        raise JsonPbError(f"unknown message type {type_name!r}")
+    return to_json(_REGISTRY[type_name].decode(raw))
+
+
+def proto_encode(type_name: str, data: Dict[str, Any]) -> bytes:
+    """JSON -> wire bytes (configtxlator proto_encode)."""
+    return from_json(type_name, data).encode()

@@ -141,3 +141,61 @@ def test_script_builder_contract(tmp_path):
     chosen.build(str(src), str(meta), str(out))
     assert (out / "marker").read_text() == "built\n"
     assert (out / "code.py").exists()
+
+
+def test_launcher_builds_and_runs_via_external_builder(tmp_path):
+    """The full detect/build/run path: an unknown package type is
+    claimed by a builder whose bin/run launches a chaincode server
+    process and publishes its address; the launcher dials it."""
+    import sys
+    root = tmp_path / "builders"
+    bdir = root / "pyrun" / "bin"
+    os.makedirs(bdir)
+    runner_py = tmp_path / "runner.py"
+    runner_py.write_text(
+        "import json, sys, time\n"
+        "sys.path.insert(0, '/root/repo')\n"
+        "from fabric_mod_tpu.peer.chaincode import KvContract\n"
+        "from fabric_mod_tpu.peer.extbuilder import ChaincodeServer\n"
+        "run_meta = sys.argv[1]\n"
+        "meta = json.load(open(run_meta + '/chaincode.json'))\n"
+        "srv = ChaincodeServer(KvContract())\n"
+        "srv.start()\n"
+        "with open(meta['address_file'] + '.tmp', 'w') as f:\n"
+        "    f.write(srv.address)\n"
+        "import os; os.replace(meta['address_file'] + '.tmp',\n"
+        "                      meta['address_file'])\n"
+        "while True:\n"
+        "    time.sleep(1)\n")
+    scripts = {
+        "detect": "#!/bin/sh\nexit 0\n",
+        "build": "#!/bin/sh\ncp -r \"$1\"/. \"$3\"/\n",
+        "run": f"#!/bin/sh\nexec {sys.executable} {runner_py} \"$2\"\n",
+    }
+    for name, body in scripts.items():
+        p = bdir / name
+        p.write_text(body)
+        p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    store = PackageStore(str(tmp_path / "pkgs"))
+    store.save(build_package("runcc", b"ignored-payload",
+                             cc_type="custom"))
+    launcher = ChaincodeLauncher(
+        store, ExternalBuilderRegistry(str(root)))
+    try:
+        cc = launcher.resolve("runcc")
+        assert isinstance(cc, ExternalContract)
+        stub = ChaincodeStub("runcc", None, [b"nosuch"], "t1", "ch")
+        with pytest.raises(ChaincodeError):
+            cc.invoke(stub)               # reaches the REMOTE contract
+        cc.close()
+    finally:
+        launcher.close()
+
+
+def test_launcher_rejects_ambiguous_label(tmp_path):
+    store = PackageStore(str(tmp_path / "pkgs"))
+    store.save(build_package("dupcc", b"v1", cc_type="python"))
+    store.save(build_package("dupcc", b"v2", cc_type="python"))
+    launcher = ChaincodeLauncher(store)
+    with pytest.raises(ExternalBuilderError, match="ambiguous"):
+        launcher.resolve("dupcc")
