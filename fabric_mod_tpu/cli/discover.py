@@ -3,8 +3,8 @@
 (reference: cmd/discover + discovery/cmd — the client CLI for the
 discovery service; peers/config/endorsers subcommands.  This tool
 builds the discovery view from a genesis/config block plus a
-membership JSON (org -> [{endpoint, mspid}]), i.e. the same inputs
-the in-process service reads from gossip.)
+membership JSON ({org: [endpoint, ...]}), i.e. the same inputs the
+in-process service reads from gossip.)
 """
 from __future__ import annotations
 

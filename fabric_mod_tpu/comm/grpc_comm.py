@@ -46,6 +46,8 @@ _rpc_metrics = None
 
 def _get_rpc_metrics():
     global _rpc_metrics
+    if _rpc_metrics is not None:           # hot path: no lock
+        return _rpc_metrics
     with _rpc_metrics_lock:
         if _rpc_metrics is None:
             from fabric_mod_tpu.observability.metrics import (

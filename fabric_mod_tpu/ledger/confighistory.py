@@ -50,8 +50,11 @@ class ConfigHistoryManager:
     (reference: confighistory/mgr.go — the compositeKV store keyed by
     (ns, blockNum) with reverse scans.)"""
 
+    SP_EVERY = 256                       # savepoint persistence cadence
+
     def __init__(self, path: Optional[str] = None):
         self._path = path
+        self._since_sp_write = 0
         self._lock = threading.Lock()
         # ns -> sorted [(block_num, collections bytes)]
         self._by_ns: Dict[str, List[Tuple[int, bytes]]] = {}
@@ -139,7 +142,14 @@ class ConfigHistoryManager:
                     cc_name, d.version, d.sequence, d.collections,
                     block_num))
             self.savepoint = block_num
-            if self._path:
+            # persist the savepoint only when a record landed or every
+            # SP_EVERY blocks: the commit hot path must not pay a file
+            # rename per block; a stale savepoint merely replays
+            # (idempotent), it never loses records
+            self._since_sp_write += 1
+            if self._path and (events
+                               or self._since_sp_write >= self.SP_EVERY):
+                self._since_sp_write = 0
                 tmp = self._path + ".sp.tmp"
                 with open(tmp, "w") as f:
                     f.write(str(block_num))

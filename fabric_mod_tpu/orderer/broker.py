@@ -114,7 +114,11 @@ class BrokerChain:
     from message counts, timeout cuts from the first TTC naming the
     next block number.  Every consumer builds identical blocks."""
 
-    OFFSET_MD_SLOT = 4                   # reference: LAST_OFFSET_PERSISTED
+    # the consenter-metadata slot (the reference's ORDERER index — its
+    # kafka chain stores LAST_OFFSET_PERSISTED there; our raft chain
+    # uses the same slot for its applied index, and a channel only
+    # ever has one consenter)
+    OFFSET_MD_SLOT = 3
 
     def __init__(self, broker: Broker, support,
                  topic: Optional[str] = None):
@@ -125,7 +129,9 @@ class BrokerChain:
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._timer_lock = threading.Lock()
         self._timer: Optional[threading.Timer] = None
-        # resume: the offset recorded in the tip block's metadata
+        # resume: the offset recorded in the tip block's metadata is
+        # the last offset INCLUDED in a block — everything after it
+        # (messages left pending at the crash) is re-consumed
         self._consumed = 0
         store = support.store
         if store.height > 1:
@@ -134,6 +140,9 @@ class BrokerChain:
             if len(md) > self.OFFSET_MD_SLOT and md[self.OFFSET_MD_SLOT]:
                 self._consumed = struct.unpack(
                     "<q", md[self.OFFSET_MD_SLOT])[0] + 1
+        # offset of the newest message sitting in the cutter's pending
+        # batch (what a cut of the pending batch must be stamped with)
+        self._pending_last = self._consumed - 1
 
     # -- consenter surface ------------------------------------------------
     def start(self) -> None:
@@ -207,12 +216,15 @@ class BrokerChain:
                 kind, number, payload = _decode(raw)
                 if kind == _TTC:
                     # first TTC for the CURRENT next block cuts; stale
-                    # duplicates (earlier numbers) are ignored
+                    # duplicates (earlier numbers) are ignored.  The
+                    # block is stamped with the last message INCLUDED
+                    # (not the TTC's offset): a restart must re-consume
+                    # anything that was still pending
                     if number == support.store.height:
                         batch = support.cutter.cut()
                         if batch:
                             self._disarm_timer()
-                            self._write(batch, offset)
+                            self._write(batch, self._pending_last)
                     self._consumed = offset + 1
                     continue
                 try:
@@ -230,7 +242,7 @@ class BrokerChain:
                     pending = support.cutter.cut()
                     if pending:
                         self._disarm_timer()
-                        self._write(pending, offset)
+                        self._write(pending, self._pending_last)
                     self._write([env], offset, is_config=True,
                                 config_env=env)
                     self._consumed = offset + 1
@@ -242,9 +254,17 @@ class BrokerChain:
                         self._consumed = offset + 1
                         continue
                 batches, pending = support.cutter.ordered(env)
-                for batch in batches:
+                for idx, batch in enumerate(batches):
                     self._disarm_timer()
-                    self._write(batch, offset)
+                    # a batch contains THIS message only when it is the
+                    # last one and nothing stayed pending; earlier
+                    # batches end at the previous pending tail
+                    contains_env = (idx == len(batches) - 1
+                                    and not pending)
+                    self._write(batch,
+                                offset if contains_env
+                                else self._pending_last)
                 if pending:
+                    self._pending_last = offset
                     self._arm_timer(support.store.height)
                 self._consumed = offset + 1

@@ -495,52 +495,70 @@ class ChaincodeLauncher:
         connection.json (directly, via release, or written by a
         launched bin/run — which receives the address file path in
         its run metadata)."""
+        import shutil
         import tempfile
         import time as _time
-        src = tempfile.mkdtemp(prefix=f"ccsrc-{label}-")
-        meta = tempfile.mkdtemp(prefix=f"ccmeta-{label}-")
-        out = tempfile.mkdtemp(prefix=f"ccout-{label}-")
-        rel = tempfile.mkdtemp(prefix=f"ccrel-{label}-")
-        with open(os.path.join(src, "code.bin"), "wb") as f:
-            f.write(code)
-        with open(os.path.join(meta, "metadata.json"), "w") as f:
-            json.dump({"label": label, "type": cc_type}, f)
-        builder = self._builders.detect(meta)
-        if builder is None:
-            raise ExternalBuilderError(
-                f"package {label}: no builder claims type {cc_type!r}")
-        builder.build(src, meta, out)
-        builder.release(out, rel)
-        for d in (rel, out):
-            conn_path = os.path.join(d, "connection.json")
-            if os.path.exists(conn_path):
-                return ExternalContract(json.load(open(conn_path)))
-        # no connection artifact: launch bin/run, which must write its
-        # listen address to the advertised file
-        run_meta = tempfile.mkdtemp(prefix=f"ccrun-{label}-")
-        addr_file = os.path.join(run_meta, "address")
-        with open(os.path.join(run_meta, "chaincode.json"), "w") as f:
-            json.dump({"address_file": addr_file}, f)
-        proc = builder.run(out, run_meta)
-        self._procs.append(proc)
-        deadline = _time.monotonic() + 30.0
-        while _time.monotonic() < deadline:
-            if os.path.exists(addr_file):
-                addr = open(addr_file).read().strip()
-                if addr:
-                    return ExternalContract({"address": addr})
-            if proc.poll() is not None:
+        work = tempfile.mkdtemp(prefix=f"ccbuild-{label}-")
+        src, meta, out, rel, run_meta = (
+            os.path.join(work, d)
+            for d in ("src", "meta", "out", "rel", "run"))
+        keep_work = False
+        try:
+            for d in (src, meta, out, rel, run_meta):
+                os.makedirs(d)
+            with open(os.path.join(src, "code.bin"), "wb") as f:
+                f.write(code)
+            with open(os.path.join(meta, "metadata.json"), "w") as f:
+                json.dump({"label": label, "type": cc_type}, f)
+            builder = self._builders.detect(meta)
+            if builder is None:
                 raise ExternalBuilderError(
-                    f"builder {builder.name}: run exited rc="
-                    f"{proc.returncode} before publishing an address")
-            _time.sleep(0.05)
-        proc.kill()
-        raise ExternalBuilderError(
-            f"builder {builder.name}: run never published an address")
+                    f"package {label}: no builder claims type "
+                    f"{cc_type!r}")
+            builder.build(src, meta, out)
+            builder.release(out, rel)
+            for d in (rel, out):
+                conn_path = os.path.join(d, "connection.json")
+                if os.path.exists(conn_path):
+                    return ExternalContract(json.load(open(conn_path)))
+            # no connection artifact: launch bin/run, which must write
+            # its listen address to the advertised file
+            addr_file = os.path.join(run_meta, "address")
+            with open(os.path.join(run_meta, "chaincode.json"),
+                      "w") as f:
+                json.dump({"address_file": addr_file}, f)
+            proc = builder.run(out, run_meta)
+            self._procs.append(proc)
+            # the run output stays alive with the process
+            keep_work = True
+            deadline = _time.monotonic() + 30.0
+            while _time.monotonic() < deadline:
+                if os.path.exists(addr_file):
+                    addr = open(addr_file).read().strip()
+                    if addr:
+                        return ExternalContract({"address": addr})
+                if proc.poll() is not None:
+                    raise ExternalBuilderError(
+                        f"builder {builder.name}: run exited rc="
+                        f"{proc.returncode} before publishing an "
+                        "address")
+                _time.sleep(0.05)
+            proc.kill()
+            proc.wait(timeout=5)           # no zombies
+            raise ExternalBuilderError(
+                f"builder {builder.name}: run never published an "
+                "address")
+        finally:
+            if not keep_work:
+                shutil.rmtree(work, ignore_errors=True)
 
     def close(self) -> None:
-        """Stop launched chaincode processes."""
+        """Stop (and reap) launched chaincode processes."""
         for proc in self._procs:
             if proc.poll() is None:
                 proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                pass
         self._procs.clear()

@@ -319,3 +319,92 @@ def test_broker_chain_restart_resumes_from_offset(tmp_path):
         for reg in regs2.values():
             reg.close()
         broker2.close()
+
+
+def test_broker_overflow_cut_does_not_lose_pending_on_restart(tmp_path):
+    """Regression (consensus safety): a byte-overflow cut writes the
+    OLD batch; the triggering message stays pending.  The block must
+    be stamped with the last INCLUDED offset — stamping the pending
+    message's offset would make a restart skip it, silently dropping
+    the transaction."""
+    from fabric_mod_tpu.bccsp.sw import SwCSP
+    from fabric_mod_tpu.channelconfig import genesis
+    from fabric_mod_tpu.msp import ca as calib
+    from fabric_mod_tpu.msp.identities import SigningIdentity
+    from fabric_mod_tpu.orderer.broker import Broker, BrokerChain
+    from fabric_mod_tpu.orderer.registrar import Registrar
+    from fabric_mod_tpu.protos import protoutil
+
+    csp = SwCSP()
+    org_ca = calib.CA("ca.org1", "Org1")
+    ord_ca = calib.CA("ca.o", "OrdererOrg")
+    cc, ck = org_ca.issue("cli", "Org1", ous=["client"])
+    client = SigningIdentity("Org1", cc, calib.key_pem(ck), csp)
+
+    def tx(k):
+        from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder
+        b = RWSetBuilder()
+        b.add_write("cc", f"key{k}", b"v")
+        return protoutil.create_signed_tx(
+            "ochan", "cc", b.build().encode(), client, [client])
+
+    env_len = len(tx(0).encode())
+    blk = genesis.standard_network(
+        "ochan", {"Org1": [calib.cert_pem(org_ca.cert)]},
+        {"OrdererOrg": [calib.cert_pem(ord_ca.cert)]},
+        consensus_type="kafka", batch_timeout="30s",  # no TTC in-test
+        max_message_count=50,
+        preferred_max_bytes=int(env_len * 2.5))
+
+    def boot(broker):
+        oc, ok = ord_ca.issue("o.o", "OrdererOrg", ous=["orderer"])
+        signer = SigningIdentity("OrdererOrg", oc, calib.key_pem(ok),
+                                 csp)
+        reg = Registrar(
+            str(tmp_path / "ord"), signer, csp,
+            chain_factory=lambda sup: BrokerChain(broker, sup))
+        if reg.get_chain("ochan") is None:
+            reg.create_channel(blk)
+        return reg
+
+    broker = Broker(str(tmp_path / "broker"))
+    reg = boot(broker)
+    sup = reg.get_chain("ochan")
+    # m1, m2 fit (2 * L <= 2.5 L... 2L < 2.5L ok); m3 overflows ->
+    # cut [m1, m2], m3 stays pending
+    for k in range(3):
+        sup.chain.order(tx(k), 0)
+    assert _wait(lambda: sup.store.height == 2)
+    assert len(sup.store.get_block_by_number(1).data.data) == 2
+    # crash with m3 pending (batch timer far away)
+    reg.close()
+
+    broker2 = Broker(str(tmp_path / "broker"))
+    reg2 = boot(broker2)
+    sup2 = reg2.get_chain("ochan")
+    try:
+        # m3 must be re-consumed; push two more so a cut fires
+        sup2.chain.order(tx(3), 0)
+        sup2.chain.order(tx(4), 0)
+        assert _wait(lambda: sum(
+            len(sup2.store.get_block_by_number(n).data.data)
+            for n in range(1, sup2.store.height)) >= 4)
+        committed = []
+        for n in range(1, sup2.store.height):
+            for env in protoutil.get_envelopes(
+                    sup2.store.get_block_by_number(n)):
+                committed.append(env.encode())
+        # no duplicates, and the once-pending m3 was NOT lost
+        assert len(committed) == len(set(committed))
+        assert tx  # keys 0,1,2,3 all present exactly once
+        keys = set()
+        for n in range(1, sup2.store.height):
+            for env in protoutil.get_envelopes(
+                    sup2.store.get_block_by_number(n)):
+                keys.update(
+                    k for k in (b"key0", b"key1", b"key2", b"key3")
+                    if k in env.encode())
+        assert {b"key0", b"key1", b"key2", b"key3"} <= keys, keys
+    finally:
+        reg2.close()
+        broker2.close()
