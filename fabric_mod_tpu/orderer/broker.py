@@ -137,9 +137,13 @@ class BrokerChain:
         if store.height > 1:
             tip = store.get_block_by_number(store.height - 1)
             md = tip.metadata.metadata if tip.metadata else []
-            if len(md) > self.OFFSET_MD_SLOT and md[self.OFFSET_MD_SLOT]:
-                self._consumed = struct.unpack(
-                    "<q", md[self.OFFSET_MD_SLOT])[0] + 1
+            # slot 4 fallback: chains written before the offset moved
+            # to the consenter slot must still resume, not re-consume
+            for slot in (self.OFFSET_MD_SLOT, 4):
+                if len(md) > slot and md[slot] and len(md[slot]) == 8:
+                    self._consumed = struct.unpack(
+                        "<q", md[slot])[0] + 1
+                    break
         # offset of the newest message sitting in the cutter's pending
         # batch (what a cut of the pending batch must be stamped with)
         self._pending_last = self._consumed - 1

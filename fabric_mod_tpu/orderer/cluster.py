@@ -139,12 +139,16 @@ class GRPCRaftTransport:
 
     def stop(self) -> None:
         self._stopped.set()
-        for q in self._queues.values():
+        with self._lock:
+            queues = list(self._queues.values())
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for q in queues:
             try:
                 q.put_nowait(None)
             except queue.Full:
-                pass
-        for client in self._clients.values():
+                pass                       # sender polls _stopped too
+        for client in clients:
             client.close()
         self.server.stop()
 
@@ -154,6 +158,8 @@ class GRPCRaftTransport:
             self._handlers[target] = handler
 
     def send(self, src: str, dst: str, msg) -> None:
+        if self._stopped.is_set():
+            return                         # no new queues after stop
         base = dst.partition(":")[0]
         if base == self.node_id:
             self._deliver(src, dst, encode_msg(msg))
@@ -191,8 +197,13 @@ class GRPCRaftTransport:
 
     def _sender(self, base: str, q: "queue.Queue") -> None:
         while not self._stopped.is_set():
-            item = q.get()
-            if item is None:
+            try:
+                # bounded wait so a full queue at stop() (dropped
+                # sentinel) still terminates promptly
+                item = q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if item is None or self._stopped.is_set():
                 return
             src, dst, raw = item
             try:

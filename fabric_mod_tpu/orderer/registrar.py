@@ -95,15 +95,21 @@ class Registrar:
     """(reference: multichannel/registrar.go)"""
 
     def __init__(self, root_dir: str, signer, csp, verify_many=None,
-                 chain_factory=None, block_fetcher=None):
+                 chain_factory=None, block_fetcher=None,
+                 consenters=None):
         """`block_fetcher`: callable(lo, hi) -> blocks, the cluster
         replication source used by follower channels and non-genesis
-        joins (reference: the cluster block puller)."""
+        joins (reference: the cluster block puller).  `consenters`:
+        {consensus_type: factory(support) -> chain} — the consenter
+        registry keyed by the channel's ConsensusType (reference:
+        registrar.go's consenters map); `chain_factory` overrides it
+        for every channel; with neither, channels run solo."""
         self._root = root_dir
         self._signer = signer
         self._csp = csp
         self._verify_many = verify_many
         self._chain_factory = chain_factory
+        self._consenters = dict(consenters or {})
         self._block_fetcher = block_fetcher
         self._chains: Dict[str, ChainSupport] = {}
         # channel ids being joined/removed right now: reserved so a
@@ -122,6 +128,14 @@ class Registrar:
                     os.path.join(path, ".joining")):
                 self._open_channel(name, path)
 
+    def _resolve_factory(self, bundle: Bundle):
+        """Consenter selection by the channel's ConsensusType
+        (reference: registrar.go consenters[consensusType]); an
+        explicit chain_factory wins, an unregistered type runs solo."""
+        if self._chain_factory is not None:
+            return self._chain_factory
+        return self._consenters.get(bundle.orderer.consensus_type)
+
     def _open_channel(self, channel_id: str, path: str) -> None:
         store = BlockStore(path)
         if store.height == 0:
@@ -139,7 +153,7 @@ class Registrar:
         # follower channels stay followers across restarts (the
         # .follower marker) — a non-member must never come back up
         # ordering (reference: the follower chain registry)
-        factory = self._chain_factory
+        factory = self._resolve_factory(bundle)
         if os.path.exists(os.path.join(path, ".follower")):
             from fabric_mod_tpu.orderer.participation import FollowerChain
 
@@ -166,7 +180,8 @@ class Registrar:
             bundle = Bundle(cid, config, self._csp)
             support = ChainSupport(cid, store, bundle, self._signer,
                                    self._csp, self._verify_many,
-                                   chain_factory=self._chain_factory)
+                                   chain_factory=self._resolve_factory(
+                                       bundle))
             self._chains[cid] = support
         support.start()
         return support
@@ -224,7 +239,7 @@ class Registrar:
                 def factory(support, f=fetch):
                     return FollowerChain(support, f)
             else:
-                factory = self._chain_factory
+                factory = self._resolve_factory(bundle)
             support = ChainSupport(cid, store, bundle, self._signer,
                                    self._csp, self._verify_many,
                                    chain_factory=factory)

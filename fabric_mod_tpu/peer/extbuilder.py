@@ -529,13 +529,14 @@ class ChaincodeLauncher:
                 json.dump({"address_file": addr_file}, f)
             proc = builder.run(out, run_meta)
             self._procs.append(proc)
-            # the run output stays alive with the process
-            keep_work = True
             deadline = _time.monotonic() + 30.0
             while _time.monotonic() < deadline:
                 if os.path.exists(addr_file):
                     addr = open(addr_file).read().strip()
                     if addr:
+                        # success: the run output stays alive with the
+                        # process; failure paths below clean up
+                        keep_work = True
                         return ExternalContract({"address": addr})
                 if proc.poll() is not None:
                     raise ExternalBuilderError(
