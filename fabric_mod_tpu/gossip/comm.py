@@ -53,6 +53,163 @@ class InProcNetwork:
             return False
 
 
+class GRPCGossipNetwork:
+    """The same register/send surface over real gRPC — one node's
+    gossip endpoint IS its host:port (reference: gossip/comm's
+    GossipStream service, collapsed to a `Gossip/Message` RPC; with
+    mTLS configured, transport-level peer auth complements the
+    per-envelope MSP signature every message already carries —
+    attribution remains signature-based, as in protoext).
+
+    Remote sends are ASYNC: per-destination bounded queues drained by
+    sender threads (the GRPCRaftTransport pattern) — a dead peer
+    drops its own traffic, never blocking the caller (which may be an
+    inbound RPC worker); gossip tolerates the loss."""
+
+    SERVICE = ("Gossip", "Message")
+    QUEUE_CAP = 256
+
+    def __init__(self, listen_address: str = "127.0.0.1:0",
+                 server_cert: Optional[bytes] = None,
+                 server_key: Optional[bytes] = None,
+                 client_ca: Optional[bytes] = None,
+                 client_cert: Optional[bytes] = None,
+                 client_key: Optional[bytes] = None,
+                 send_timeout_s: float = 1.5):
+        import base64
+        import json
+        import queue
+        from fabric_mod_tpu.comm.grpc_comm import (
+            GRPCClient, GRPCServer, MethodKind)
+        self._b64 = base64.b64encode
+        self._unb64 = base64.b64decode
+        self._json = json
+        self._queue_mod = queue
+        self._GRPCClient = GRPCClient
+        self._client_tls = (client_ca, client_cert, client_key)
+        self._timeout = send_timeout_s
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._handlers: Dict[str, Handler] = {}
+        self._clients: Dict[str, object] = {}
+        self._queues: Dict[str, object] = {}
+        self.partitioned: set = set()      # honored like InProcNetwork
+        self.server = GRPCServer(listen_address,
+                                 server_cert_pem=server_cert,
+                                 server_key_pem=server_key,
+                                 client_root_pem=client_ca)
+        host = listen_address.rsplit(":", 1)[0]
+        self.listen_endpoint = f"{host}:{self.server.port}"
+        self.server.register(*self.SERVICE, MethodKind.UNARY,
+                             self._on_message)
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+            queues = list(self._queues.values())
+        for q in queues:
+            try:
+                q.put_nowait(None)
+            except Exception:
+                pass                       # senders poll _stopped too
+        for c in clients:
+            c.close()
+        self.server.stop()
+
+    # -- the network surface ---------------------------------------------
+    def register(self, endpoint: str, handler: Handler) -> None:
+        with self._lock:
+            self._handlers[endpoint] = handler
+
+    def unregister(self, endpoint: str) -> None:
+        with self._lock:
+            self._handlers.pop(endpoint, None)
+
+    def send(self, src_endpoint: str, src_pki_id: bytes,
+             dst_endpoint: str, env_bytes: bytes) -> bool:
+        if self._stopped.is_set():
+            return False
+        if src_endpoint in self.partitioned or \
+                dst_endpoint in self.partitioned:
+            return False
+        with self._lock:
+            local = self._handlers.get(dst_endpoint)
+        if local is not None:              # same-process shortcut
+            try:
+                local(src_pki_id, env_bytes)
+                return True
+            except Exception:
+                return False
+        payload = self._json.dumps(
+            {"dst": dst_endpoint,
+             "pki": self._b64(src_pki_id).decode(),
+             "env": self._b64(env_bytes).decode()}).encode()
+        q = self._queue_for(dst_endpoint)
+        try:
+            q.put_nowait(payload)
+            return True                    # best-effort enqueue
+        except Exception:
+            return False                   # full: drop (gossip re-sends)
+
+    # -- internals --------------------------------------------------------
+    def _queue_for(self, endpoint: str):
+        with self._lock:
+            q = self._queues.get(endpoint)
+            if q is None:
+                q = self._queue_mod.Queue(self.QUEUE_CAP)
+                self._queues[endpoint] = q
+                threading.Thread(target=self._sender,
+                                 args=(endpoint, q),
+                                 daemon=True).start()
+            return q
+
+    def _sender(self, endpoint: str, q) -> None:
+        while not self._stopped.is_set():
+            try:
+                payload = q.get(timeout=0.5)
+            except Exception:
+                continue
+            if payload is None or self._stopped.is_set():
+                return
+            try:
+                self._client_for(endpoint).unary(
+                    *self.SERVICE, payload, timeout=self._timeout)
+            except Exception:
+                with self._lock:
+                    client = self._clients.pop(endpoint, None)
+                if client is not None:
+                    client.close()
+
+    def _client_for(self, endpoint: str):
+        with self._lock:
+            if self._stopped.is_set():
+                raise RuntimeError("network stopped")
+            client = self._clients.get(endpoint)
+            if client is None:
+                ca, cert, key = self._client_tls
+                client = self._GRPCClient(endpoint, server_root_pem=ca,
+                                          client_cert_pem=cert,
+                                          client_key_pem=key)
+                self._clients[endpoint] = client
+            return client
+
+    def _on_message(self, request: bytes, context) -> bytes:
+        try:
+            d = self._json.loads(request)
+            with self._lock:
+                handler = self._handlers.get(d["dst"])
+            if handler is not None:
+                handler(self._unb64(d["pki"]), self._unb64(d["env"]))
+        except Exception:
+            pass
+        return b""
+
+
 class GossipComm:
     """One node's sending surface (reference: comm_impl.go Send)."""
 
