@@ -121,8 +121,22 @@ class OrdererConfig:
     batch_size: m.BatchSize
     batch_timeout_s: float
     consensus_type: str
+    consensus_metadata: bytes           # consenter set etc. (reference:
+    #                                     ConsensusType.Metadata)
     org_mspids: Tuple[str, ...]
     capabilities: Tuple[str, ...]
+
+    def consenters(self) -> Tuple[str, ...]:
+        """Raft consenter node ids from the consensus metadata
+        (reference: etcdraft.ConfigMetadata's consenter list); empty
+        when the channel predates/omits the metadata."""
+        if not self.consensus_metadata:
+            return ()
+        try:
+            md = m.RaftMetadata.decode(self.consensus_metadata)
+        except Exception:
+            return ()
+        return tuple(md.consenters)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -189,13 +203,15 @@ class Bundle:
             vals = values_of(osec)
             if BATCH_SIZE not in vals or BATCH_TIMEOUT not in vals:
                 raise ConfigError("orderer group needs BatchSize/BatchTimeout")
-            ct = (m.ConsensusType.decode(vals[CONSENSUS_TYPE].value).type
-                  if CONSENSUS_TYPE in vals else "solo")
+            ctv = (m.ConsensusType.decode(vals[CONSENSUS_TYPE].value)
+                   if CONSENSUS_TYPE in vals else m.ConsensusType(
+                       type="solo"))
             self.orderer = OrdererConfig(
                 batch_size=m.BatchSize.decode(vals[BATCH_SIZE].value),
                 batch_timeout_s=_parse_timeout(
                     m.BatchTimeout.decode(vals[BATCH_TIMEOUT].value).timeout),
-                consensus_type=ct,
+                consensus_type=ctv.type or "solo",
+                consensus_metadata=ctv.metadata,
                 org_mspids=tuple(sorted(groups_of(osec))),
                 capabilities=_capabilities(vals))
 

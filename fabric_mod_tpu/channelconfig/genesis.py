@@ -102,7 +102,8 @@ def orderer_group(orgs: Sequence[m.ConfigGroup], org_names: Sequence[str],
                   max_message_count: int = 500,
                   absolute_max_bytes: int = 10 * 1024 * 1024,
                   preferred_max_bytes: int = 2 * 1024 * 1024,
-                  batch_timeout: str = "2s") -> m.ConfigGroup:
+                  batch_timeout: str = "2s",
+                  consenters: Sequence[str] = ()) -> m.ConfigGroup:
     g = m.ConfigGroup(mod_policy=ADMINS)
     for name, org in zip(org_names, orgs):
         set_group(g, name, org)
@@ -117,8 +118,10 @@ def orderer_group(orgs: Sequence[m.ConfigGroup], org_names: Sequence[str],
         preferred_max_bytes=preferred_max_bytes)))
     set_value(g, BATCH_TIMEOUT, _config_value(
         m.BatchTimeout(timeout=batch_timeout)))
-    set_value(g, CONSENSUS_TYPE, _config_value(
-        m.ConsensusType(type=consensus_type)))
+    set_value(g, CONSENSUS_TYPE, _config_value(m.ConsensusType(
+        type=consensus_type,
+        metadata=(m.RaftMetadata(consenters=list(consenters)).encode()
+                  if consenters else b""))))
     return g
 
 

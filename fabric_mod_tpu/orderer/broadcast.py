@@ -41,9 +41,11 @@ class Broadcast:
             try:
                 wrapped, seq = \
                     support.processor.process_config_update_msg(env)
+                # consenters' pre-order checks (e.g. the raft
+                # one-membership-change rule) are client faults too
+                support.chain.configure(wrapped, seq)
             except _CLIENT_FAULTS as e:
                 raise BroadcastError(f"config update rejected: {e}") from e
-            support.chain.configure(wrapped, seq)
         else:
             try:
                 seq = support.processor.process_normal_msg(env)

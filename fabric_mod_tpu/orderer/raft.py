@@ -335,6 +335,7 @@ class RaftNode:
         self._install_cb = install_cb
 
         self.state = FOLLOWER
+        self.member = True                 # False once reconfigured out
         self.leader_id: Optional[str] = None
         self.commit_index = self._wal.snap_index
         self.last_applied = self._wal.snap_index
@@ -368,6 +369,16 @@ class RaftNode:
         self._q.put(("propose", data))
         return True
 
+    def update_peers(self, node_ids) -> None:
+        """Reconfigure the member set (applied on the FSM thread).
+        Every replica calls this when the SAME committed config entry
+        applies, so membership switches at identical log points —
+        apply-time reconfiguration, the reference's ConfChange-on-
+        config-block model (etcdraft chain.go's raft.ApplyConfChange).
+        Callers must change at most ONE member per config (quorum
+        overlap; enforced by the chain layer)."""
+        self._q.put(("reconfig", list(node_ids)))
+
     @property
     def last_index(self) -> int:
         return self._wal.last_index
@@ -389,6 +400,21 @@ class RaftNode:
                 self._on_message(item[1], item[2])
             elif kind == "propose":
                 self._on_propose(item[1])
+            elif kind == "reconfig":
+                self._on_reconfig(item[1])
+
+    def _on_reconfig(self, node_ids) -> None:
+        self.member = self.id in node_ids
+        self.peers = [p for p in node_ids if p != self.id]
+        for gone in [p for p in self._next_index
+                     if p not in self.peers]:
+            self._next_index.pop(gone, None)
+            self._match_index.pop(gone, None)
+        if not self.member and self.state == LEADER:
+            # a removed leader steps down; it keeps serving as a
+            # non-voting observer until halted (reference: the raft
+            # eviction path — chain.go:1335)
+            self._step_down(self._wal.term)
 
     def _reset_election_timer(self) -> None:
         self._deadline = (time.monotonic()
@@ -398,8 +424,10 @@ class RaftNode:
         if self.state == LEADER:
             self._broadcast_append()
             self._deadline = time.monotonic() + self._hb
-        else:
+        elif self.member:
             self._start_election()
+        else:
+            self._reset_election_timer()   # observers never campaign
 
     # -- elections --------------------------------------------------------
     def _start_election(self) -> None:
@@ -498,6 +526,8 @@ class RaftNode:
             self._on_install_snapshot(msg)
 
     def _on_request_vote(self, msg: RequestVote) -> None:
+        if msg.candidate not in self.peers:
+            return                         # non-members cannot campaign
         if msg.term > self._wal.term:
             self._step_down(msg.term)
         granted = False

@@ -75,11 +75,21 @@ class RaftChain:
         self._support = support
         self._transport = transport
         self._fetch_blocks = block_fetcher
+        # the channel config's consenter set (ConsensusType.metadata)
+        # is authoritative when present; the ctor list is the
+        # bootstrap fallback (reference: consenters from ConfigMetadata)
+        cfg_set = support.bundle().orderer.consenters()
+        if cfg_set:
+            peer_ids = list(cfg_set)
         self._raft = RaftNode(node_id, peer_ids, transport, wal_path,
                               self._apply, election_timeout, heartbeat_s,
                               snapshot_interval=snapshot_interval,
                               snapshot_cb=self._snapshot_state,
                               install_cb=self._install_snapshot)
+        if cfg_set and node_id not in cfg_set:
+            # configured out (or not yet in): run as observer — apply
+            # committed entries, never campaign
+            self._raft.member = False
         transport.register(f"{node_id}:chain", self._on_chain_msg)
         self._q: "queue.Queue[Optional[_Submit]]" = queue.Queue(10_000)
         self._halted = threading.Event()
@@ -123,7 +133,41 @@ class RaftChain:
 
     def configure(self, env: m.Envelope, config_seq: int) -> None:
         self.wait_ready()
+        self._check_membership_change(env)
         self._q.put(_Submit(env.encode(), True, config_seq))
+
+    def _check_membership_change(self, env: m.Envelope) -> None:
+        """Reject consenter-set changes touching more than ONE member:
+        single-server reconfiguration keeps old/new quorums overlapping
+        (reference: etcdraft's one-change-per-config rule,
+        consenter.go's CheckConfigMetadata)."""
+        try:
+            payload = protoutil.unmarshal_envelope_payload(env)
+            cenv = m.ConfigEnvelope.decode(payload.data)
+            if cenv.config is None:
+                return
+            from fabric_mod_tpu.channelconfig import Bundle
+            new_bundle = Bundle(self._support.channel_id, cenv.config,
+                                self._support._csp)
+            new_set = set(new_bundle.orderer.consenters())
+        except Exception:
+            return                         # not a readable config: let
+            #                                normal validation reject it
+        if not new_set:
+            return                         # channel doesn't track a set
+        cur = set(self._current_consenters())
+        if not cur:
+            return
+        if len(cur ^ new_set) > 1:
+            raise ValueError(
+                "consenter reconfiguration must add or remove at most "
+                f"one member per config update (got {sorted(cur)} -> "
+                f"{sorted(new_set)})")
+
+    def _current_consenters(self):
+        got = self._support.bundle().orderer.consenters()
+        return got if got else tuple([self.node_id] + [
+            p for p in self._raft.peers])
 
     # -- submit routing ----------------------------------------------------
     def _on_chain_msg(self, src: str, msg) -> None:
@@ -246,6 +290,16 @@ class RaftChain:
                 self._append_fetched(block)
         if support.store.height < target:
             raise RuntimeError("catch-up fetched too few blocks")
+        # fetched blocks may include config blocks that changed the
+        # consenter set: raft membership must follow the bundle the
+        # catch-up just installed (the WAL entries covering these
+        # blocks are skipped by _applied_upto, so _apply's
+        # update_peers would never fire for them)
+        cfg_set = support.bundle().orderer.consenters()
+        if cfg_set:
+            # install_cb runs ON the FSM thread: apply synchronously
+            # (enqueueing via update_peers would act one message late)
+            self._raft._on_reconfig(list(cfg_set))
         # trust the raft index recorded in the fetched tip block (it
         # equals the snapshot index, but the block metadata is the
         # authoritative record) so WAL-replayed entries covering the
@@ -315,7 +369,40 @@ class RaftChain:
             md.append(b"")
         md[self.RAFT_INDEX_MD_SLOT] = index.to_bytes(8, "big")
         if kind == _CONFIG:
+            if not self._config_still_valid(envs[0]):
+                # deterministic skip on every replica: a config raced
+                # by another config at the same sequence (or one whose
+                # membership change became multi-member against the
+                # NOW-current set) must not apply — the submission-time
+                # checks ran against a stale bundle
+                self._applied_upto = index
+                return
+            before = support.bundle().orderer.consenters()
             support.process_config(envs[0], block)
+            after = support.bundle().orderer.consenters()
+            if after and set(after) != set(before):
+                # membership switches exactly when the config entry
+                # applies — every replica reaches this at the same log
+                # index (reference: ApplyConfChange on config commit)
+                self._raft.update_peers(after)
         else:
             support.writer.write_block(block)
         self._applied_upto = index
+
+    def _config_still_valid(self, env: m.Envelope) -> bool:
+        """Apply-time re-validation (all replicas decide identically):
+        the wrapped config must advance the sequence by exactly one,
+        and its consenter change must still be single-member against
+        the CURRENT set (two racing single-member updates validated
+        against the same stale bundle would otherwise compose into the
+        multi-member jump the guard forbids)."""
+        try:
+            payload = protoutil.unmarshal_envelope_payload(env)
+            cenv = m.ConfigEnvelope.decode(payload.data)
+            if cenv.config is None or \
+                    cenv.config.sequence != self._support.sequence() + 1:
+                return False
+            self._check_membership_change(env)
+            return True
+        except Exception:
+            return False

@@ -670,6 +670,15 @@ class ConsensusType(Msg):
     state: int = 0
 
 
+@message
+class RaftMetadata(Msg):
+    """Consenter set carried in ConsensusType.metadata (reference:
+    etcdraft.ConfigMetadata — ours lists transport node ids; consenter
+    TLS identity is pinned at the cluster-comm layer)."""
+    FIELDS = ((1, "consenters", ["s"]),)
+    consenters: List[str] = _f(default_factory=list)
+
+
 # --- msp/msp_config.proto --------------------------------------------------
 
 @message

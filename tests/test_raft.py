@@ -543,3 +543,185 @@ def test_raft_chain_snapshot_catchup_pulls_blocks(cluster, tmp_path):
     finally:
         for reg in registrars.values():
             reg.close()
+
+
+# --- consenter reconfiguration ----------------------------------------------
+
+def _consenter_update(world, support, new_consenters):
+    """Build+submit a config update replacing the consenter set."""
+    from fabric_mod_tpu.channelconfig import (
+        compute_update, signed_update_envelope)
+    from fabric_mod_tpu.channelconfig.bundle import (
+        CONSENSUS_TYPE, ORDERER, groups_of, set_group, set_value,
+        values_of)
+    from fabric_mod_tpu.msp import ca as calib
+    from fabric_mod_tpu.msp.identities import SigningIdentity
+    cur = support.bundle().config
+    desired = m.ConfigGroup.decode(cur.channel_group.encode())
+    osec = groups_of(desired)[ORDERER]
+    ctv = values_of(osec)[CONSENSUS_TYPE]
+    ct = m.ConsensusType.decode(ctv.value)
+    ct.metadata = m.RaftMetadata(
+        consenters=list(new_consenters)).encode()
+    ctv.value = ct.encode()
+    set_value(osec, CONSENSUS_TYPE, ctv)
+    set_group(desired, ORDERER, osec)
+    update = compute_update(support.channel_id, cur, desired)
+    ocert, okey = world["ord_ca"].issue(
+        "admin%d@orderer" % len(new_consenters), "OrdererOrg",
+        ous=["admin"])
+    oadmin = SigningIdentity("OrdererOrg", ocert, calib.key_pem(okey),
+                             world["csp"])
+    env = signed_update_envelope(support.channel_id, update, [oadmin])
+    wrapped, seq = support.processor.process_config_update_msg(env)
+    support.chain.configure(wrapped, seq)
+
+
+@pytest.fixture()
+def reconf_cluster(tmp_path):
+    """3 consenters declared IN the channel config's raft metadata."""
+    from fabric_mod_tpu.bccsp.sw import SwCSP
+    from fabric_mod_tpu.channelconfig import genesis
+    from fabric_mod_tpu.msp import ca as calib
+    from fabric_mod_tpu.msp.identities import SigningIdentity
+    from fabric_mod_tpu.orderer.registrar import Registrar
+
+    csp = SwCSP()
+    org_ca = calib.CA("ca.org1", "Org1")
+    ord_ca = calib.CA("ca.orderer", "OrdererOrg")
+    ids = ["r0", "r1", "r2"]
+    blk = genesis.standard_network(
+        "reconf", {"Org1": [calib.cert_pem(org_ca.cert)]},
+        {"OrdererOrg": [calib.cert_pem(ord_ca.cert)]},
+        consensus_type="etcdraft", batch_timeout="150ms",
+        max_message_count=5, consenters=ids)
+    transport = RaftTransport()
+    registrars = {}
+
+    def boot(i):
+        ocert, okey = ord_ca.issue(f"{i}.orderer", "OrdererOrg",
+                                   ous=["orderer"])
+        signer = SigningIdentity("OrdererOrg", ocert,
+                                 calib.key_pem(okey), csp)
+
+        def factory(support, i=i):
+            return RaftChain(i, ids, transport,
+                             str(tmp_path / f"{i}.wal"), support)
+        reg = Registrar(str(tmp_path / i), signer, csp,
+                        chain_factory=factory)
+        reg.create_channel(blk)
+        registrars[i] = reg
+        return reg
+    for i in ids:
+        boot(i)
+    world = {"csp": csp, "org_ca": org_ca, "ord_ca": ord_ca,
+             "ids": ids, "transport": transport, "genesis": blk,
+             "registrars": registrars, "tmp": tmp_path, "boot": boot,
+             "supports": {i: registrars[i].get_chain("reconf")
+                          for i in ids}}
+    yield world
+    for reg in registrars.values():
+        reg.close()
+
+
+def _all_txs(support):
+    return sum(len(support.store.get_block_by_number(b).data.data)
+               for b in range(1, support.store.height))
+
+
+def test_consenter_removal_via_config(reconf_cluster):
+    """A config update removing one consenter: the removed node stops
+    campaigning (observer), the remaining two keep ordering."""
+    world = reconf_cluster
+    sup = world["supports"]
+    chains = {i: s.chain for i, s in sup.items()}
+    assert _wait(lambda: any(c.is_leader for c in chains.values()),
+                 timeout=15.0)
+    for k in range(4):
+        sup["r0"].chain.order(_client_env_for(world, k), 0)
+    assert _wait(lambda: all(_all_txs(s) >= 4 for s in sup.values()),
+                 timeout=20.0)
+    victim = next(i for i, c in chains.items() if not c.is_leader)
+    keep = [i for i in world["ids"] if i != victim]
+    leader_id = next(i for i, c in chains.items() if c.is_leader)
+    _consenter_update(world, sup[leader_id], keep)
+    assert _wait(lambda: all(
+        s.bundle().sequence == 1 for s in sup.values()), timeout=20.0)
+    # the removed node became an observer
+    assert _wait(lambda: not sup[victim].chain._raft.member,
+                 timeout=10.0)
+    # survivors keep ordering with a 2-node quorum
+    leader_id = next(i for i in keep if sup[i].chain.is_leader) if any(
+        sup[i].chain.is_leader for i in keep) else keep[0]
+    for k in range(4, 8):
+        sup[leader_id].chain.order(_client_env_for(world, k), 0)
+    assert _wait(lambda: all(_all_txs(sup[i]) >= 8 for i in keep),
+                 timeout=20.0)
+    # multi-member changes are refused at submission
+    with pytest.raises(Exception):
+        _consenter_update(world, sup[leader_id],
+                          [keep[0], "x1", "x2"])
+
+
+def test_consenter_addition_via_config(reconf_cluster):
+    """Adding a NEW node: a config update admits r3; a fresh replica
+    booted from genesis catches up (it sees the config block in the
+    replicated log) and becomes a voting member."""
+    world = reconf_cluster
+    sup = world["supports"]
+    chains = {i: s.chain for i, s in sup.items()}
+    assert _wait(lambda: any(c.is_leader for c in chains.values()),
+                 timeout=15.0)
+    leader_id = next(i for i, c in chains.items() if c.is_leader)
+    for k in range(3):
+        sup[leader_id].chain.order(_client_env_for(world, k), 0)
+    assert _wait(lambda: all(_all_txs(s) >= 3 for s in sup.values()),
+                 timeout=20.0)
+    new_ids = world["ids"] + ["r3"]
+    _consenter_update(world, sup[leader_id], new_ids)
+    assert _wait(lambda: all(
+        s.bundle().sequence == 1 for s in sup.values()), timeout=20.0)
+
+    # boot the new replica: genesis bundle says it is NOT a member
+    # (observer) until it applies the config entry from the log
+    from fabric_mod_tpu.msp import ca as calib
+    from fabric_mod_tpu.msp.identities import SigningIdentity
+    from fabric_mod_tpu.orderer.registrar import Registrar
+    ocert, okey = world["ord_ca"].issue("r3.orderer", "OrdererOrg",
+                                        ous=["orderer"])
+    signer = SigningIdentity("OrdererOrg", ocert, calib.key_pem(okey),
+                             world["csp"])
+
+    def factory(support):
+        return RaftChain("r3", new_ids, world["transport"],
+                         str(world["tmp"] / "r3.wal"), support)
+    reg3 = Registrar(str(world["tmp"] / "r3"), signer, world["csp"],
+                     chain_factory=factory)
+    reg3.create_channel(world["genesis"])
+    world["registrars"]["r3"] = reg3
+    sup3 = reg3.get_chain("reconf")
+    assert not sup3.chain._raft.member     # observer at boot
+    # it catches up through the replicated log and becomes a member
+    assert _wait(lambda: sup3.store.height ==
+                 sup[leader_id].store.height, timeout=25.0)
+    assert _wait(lambda: sup3.chain._raft.member, timeout=10.0)
+    # and participates: order more, everyone converges
+    for k in range(3, 6):
+        sup[leader_id].chain.order(_client_env_for(world, k), 0)
+    assert _wait(lambda: _all_txs(sup3) >= 6, timeout=20.0)
+
+
+def _client_env_for(world, k):
+    from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder
+    from fabric_mod_tpu.msp import ca as calib
+    from fabric_mod_tpu.msp.identities import SigningIdentity
+    if "client" not in world:
+        cc, ck = world["org_ca"].issue("cli@org1", "Org1",
+                                      ous=["client"])
+        world["client"] = SigningIdentity(
+            "Org1", cc, calib.key_pem(ck), world["csp"])
+    b = RWSetBuilder()
+    b.add_write("cc", f"rk{k}", b"v")
+    return protoutil.create_signed_tx(
+        "reconf", "cc", b.build().encode(), world["client"],
+        [world["client"]])
