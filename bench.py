@@ -228,6 +228,30 @@ def run_worker(args) -> int:
 # Supervisor (parent): hard timeouts, retries, CPU fallback
 # ---------------------------------------------------------------------------
 
+def _preflight_probe(env, timeout_s: float):
+    """Cheap TPU liveness probe in a throwaway child: just jax.devices().
+
+    A hung axon tunnel used to cost the whole measurement budget
+    (BENCH_r03 post-mortem: one 600s attempt, tunnel hung, round
+    recorded the CPU fallback).  The probe bounds that discovery to
+    `timeout_s`: if the backend cannot even enumerate devices in that
+    window, the supervisor skips straight to the CPU fallback and the
+    budget is spent measuring, not waiting.
+    """
+    code = ("import jax, sys; d = jax.devices(); "
+            "sys.stdout.write(d[0].platform)")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              timeout=timeout_s, stdout=subprocess.PIPE,
+                              stderr=subprocess.DEVNULL)
+    except subprocess.TimeoutExpired:
+        return None, f"probe hung >{timeout_s:.0f}s"
+    if proc.returncode != 0:
+        return None, f"probe rc={proc.returncode}"
+    platform = proc.stdout.decode().strip() or "unknown"
+    return platform, f"probe ok: platform={platform}"
+
+
 def _spawn_worker(argv, env, timeout_s: float):
     """Run this script with --_worker; return (json_dict | None, note)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--_worker"] + argv
@@ -251,17 +275,30 @@ def _spawn_worker(argv, env, timeout_s: float):
 
 
 def supervise(args, argv) -> int:
-    # Calibrated against the observed axon failure mode (r2 + three
-    # probes this round, each hanging ~25min then UNAVAILABLE): when
-    # the tunnel is broken it is broken for the whole session, so
-    # retries only burn the budget — ONE hard-capped attempt, then the
-    # bounded CPU fallback (~8min cold-cache at bucket 512).
+    # Two observed axon failure modes (r2/r3 post-mortems): the tunnel
+    # hangs indefinitely at backend init, or comes up slowly but then
+    # works for the whole session.  So: (1) a cheap bounded pre-flight
+    # probe discovers a dead tunnel in minutes, not the whole budget;
+    # (2) if the probe passes, TWO measurement attempts by default —
+    # the persistent XLA compile cache (bccsp/tpu._enable_compile_cache,
+    # shared via FABRIC_MOD_TPU_JIT_CACHE) makes the second attempt
+    # skip the cold compile, so it is cheap.
     timeout_s = float(os.environ.get("FABRIC_MOD_TPU_BENCH_TIMEOUT", "600"))
-    attempts = int(os.environ.get("FABRIC_MOD_TPU_BENCH_ATTEMPTS", "1"))
+    attempts = int(os.environ.get("FABRIC_MOD_TPU_BENCH_ATTEMPTS", "2"))
+    probe_s = float(os.environ.get("FABRIC_MOD_TPU_BENCH_PROBE_TIMEOUT",
+                                   "180"))
     base_env = dict(os.environ)
+    # one shared persistent compile cache across probe/attempts
+    base_env.setdefault("FABRIC_MOD_TPU_JIT_CACHE",
+                        os.path.expanduser("~/.cache/fabric_mod_tpu/jit"))
 
     note = "no TPU attempts configured"
     if not args.cpu:
+        platform, pnote = _preflight_probe(base_env, probe_s)
+        log(f"[bench] pre-flight: {pnote}")
+        if platform is None:
+            attempts = 0
+            note = pnote
         for attempt in range(1, attempts + 1):
             log(f"[bench] device attempt {attempt}/{attempts} "
                 f"(timeout {timeout_s:.0f}s)")
@@ -274,9 +311,9 @@ def supervise(args, argv) -> int:
                 backoff = 15 * attempt
                 log(f"[bench] backing off {backoff}s before retry")
                 time.sleep(backoff)
-        diagnosis = ("TPU backend init failed or hung in all "
-                     f"{attempts} attempts; falling back to CPU backend. "
-                     "Last failure: " + note)
+        diagnosis = ("TPU backend init failed or hung "
+                     f"(pre-flight: {pnote}; attempts: {attempts}); "
+                     "falling back to CPU backend. Last failure: " + note)
         log(f"[bench] {diagnosis}")
     else:
         diagnosis = "forced --cpu"
