@@ -89,16 +89,14 @@ class TpuVerifier:
     """
 
     def __init__(self, mesh=None):
-        self._sharding = None
+        self._mesh = mesh
         self._mesh_size = 1
         if mesh is not None:
-            from fabric_mod_tpu.parallel import batch_sharding
             self._mesh_size = int(np.prod(mesh.devices.shape))
             if BUCKETS[-1] % self._mesh_size != 0:
                 raise ValueError(
                     f"mesh size {self._mesh_size} must divide the max "
                     f"bucket {BUCKETS[-1]} (use a power-of-two mesh)")
-            self._sharding = batch_sharding(mesh)
 
     def verify_many(self, items: Sequence[VerifyItem]) -> np.ndarray:
         n = len(items)
@@ -132,7 +130,7 @@ class TpuVerifier:
             except Exception:
                 continue
         from fabric_mod_tpu.ops import p256
-        mask = p256.batch_verify(d, r, s, qx, qy, sharding=self._sharding)
+        mask = p256.batch_verify(d, r, s, qx, qy, mesh=self._mesh)
         return (mask & pre_ok)[:n]
 
 

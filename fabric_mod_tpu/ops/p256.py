@@ -7,23 +7,24 @@ signature behind a semaphore (core/committer/txvalidator/v20/
 validator.go:194-239), the whole block's (digest, r, s, pubkey) tuples
 become device arrays and one jitted program verifies them all.
 
+Field arithmetic is the f32/MXU limb layer (ops/limbs9.py): radix-2^9
+limbs with the limb axis FIRST — (K, batch) arrays — so element-wise
+work fills all vector lanes and the schoolbook/Montgomery folds run as
+constant matmuls on the MXU.
+
 Point arithmetic uses the Renes-Costello-Batina *complete* projective
 addition formulas for a=-3 short Weierstrass curves (eprint 2015/1060,
-algorithm 4).  Complete formulas are the TPU-idiomatic choice: they are
-branch-free — identity, doubling, and inverse cases all fall out of the
-same straight-line code — so a batch never diverges and XLA sees one
-fused SIMD program.  Doubling is ``add(P, P)`` (valid by completeness);
-a dedicated doubling routine is a later optimisation.
+algorithms 4 and 6).  Complete formulas are the TPU-idiomatic choice:
+they are branch-free — identity, doubling, and inverse cases all fall
+out of the same straight-line code — so a batch never diverges and XLA
+sees one fused SIMD program.
 
-Scalar multiplication u1*G + u2*Q is one interleaved (Shamir) ladder:
-256 iterations of double + table-select-add where the 4-entry table
-[inf, G, Q, G+Q] is selected per lane by the current bit pair.  The
+Scalar multiplication u1*G + u2*Q is one interleaved windowed (Shamir)
+ladder: 64 steps of 4 doublings + two table-adds, where the 16-entry
+G table is a host-precomputed constant (selected by one-hot matmul on
+the MXU) and the 16-entry Q table is built on device per lane.  The
 final comparison avoids an inversion: accept iff X == (r + k*n)*Z
 (mod p) for k in {0, 1} (with r + k*n < p), Z != 0.
-
-All field values live in the Montgomery domain of ops/limbs.py
-(25 x 11-bit signed lazy limbs).  Everything here is shape-static and
-scan-based, so the program jits once per batch size.
 """
 from __future__ import annotations
 
@@ -33,10 +34,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fabric_mod_tpu.ops import limbs
-from fabric_mod_tpu.ops.limbs import (
+from fabric_mod_tpu.ops import limbs9 as limbs
+from fabric_mod_tpu.ops.limbs9 import (
     FieldSpec, K, add, sub, mont_mul, mont_sqr, to_mont, eq_zero,
     mul_small, canonical, bits_le, inv_mont, be_bytes_to_limbs,
+    const_like, const_dot,
 )
 
 WINDOW = 4                     # Shamir ladder window width (bits)
@@ -92,11 +94,11 @@ def _g_table():
     """(3, TABLE, K) numpy constants: projective Montgomery-domain
     multiples [inf, G, 2G, ..., 15G] of the fixed base point, shared by
     every batch lane of the windowed ladder (the base point is a curve
-    constant, so this table is host-precomputed once — unlike the
-    per-signature Q table built on device)."""
+    constant — unlike the per-signature Q table built on device)."""
     R = 1 << limbs.RBITS
     one_m = limbs.int_to_limbs(R % P)
-    xs, ys, zs = [np.zeros(K, np.int32)], [one_m.copy()], [np.zeros(K, np.int32)]
+    zero = np.zeros(K, np.float32)
+    xs, ys, zs = [zero], [one_m.copy()], [np.zeros(K, np.float32)]
     acc = None
     for _ in range(1, TABLE):
         acc = _affine_add(acc, (GX, GY))
@@ -106,15 +108,16 @@ def _g_table():
     return np.stack([np.stack(xs), np.stack(ys), np.stack(zs)])
 
 
-# --- Complete projective point addition (RCB alg. 4, a = -3) ---------------
+# --- Complete projective point addition (RCB alg. 4/6, a = -3) -------------
 
 def point_add(p1, p2, fp: FieldSpec, b_m: jnp.ndarray):
     """Complete addition of projective points (X:Y:Z), Montgomery domain.
 
     Valid for ALL inputs on the (prime-order) curve, including P == Q,
-    P == -Q, and either operand at infinity (0:1:0).  Batched over
-    leading axes.  12 muls + 2 muls-by-b; every add/sub re-normalises
-    limbs so lazy value bounds stay far inside limbs.py's 2**262 domain.
+    P == -Q, and either operand at infinity (0:1:0).  Arrays are
+    (K, ...batch); `b_m` must already be rank-matched (const_like).
+    12 muls + 2 muls-by-b; every add/sub re-normalises limbs so lazy
+    value bounds stay far inside limbs9's 2**260 domain.
     """
     X1, Y1, Z1 = p1
     X2, Y2, Z2 = p2
@@ -167,8 +170,8 @@ def point_add(p1, p2, fp: FieldSpec, b_m: jnp.ndarray):
 def point_double(p, fp: FieldSpec, b_m: jnp.ndarray):
     """Complete projective doubling (RCB alg. 6, a = -3), Montgomery
     domain.  Valid for ALL curve points including infinity.  3 squarings
-    (cheap via sb_sqr_full) + 8 muls + 2 muls-by-b — ~20% cheaper than
-    doubling through the generic complete addition."""
+    + 8 muls + 2 muls-by-b — ~20% cheaper than doubling through the
+    generic complete addition."""
     X, Y, Z = p
     t0 = mont_sqr(X, fp)
     t1 = mont_sqr(Y, fp)
@@ -207,11 +210,13 @@ def point_double(p, fp: FieldSpec, b_m: jnp.ndarray):
     return (X3, Y3, Z3)
 
 
-def infinity(shape_prefix) -> tuple:
-    """The projective identity (0 : 1 : 0) in Montgomery domain."""
+def infinity(shape_suffix) -> tuple:
+    """The projective identity (0 : 1 : 0), (K, *shape_suffix) arrays."""
     fp, _, _, _, _ = _consts()
-    zero = jnp.zeros(shape_prefix + (K,), jnp.int32)
-    one = jnp.broadcast_to(fp.one_mont, shape_prefix + (K,)).astype(jnp.int32)
+    zero = jnp.zeros((K,) + tuple(shape_suffix), jnp.float32)
+    one = jnp.broadcast_to(
+        jnp.asarray(fp.one_mont).reshape((K,) + (1,) * len(shape_suffix)),
+        (K,) + tuple(shape_suffix)).astype(jnp.float32)
     return (zero, one, zero)
 
 
@@ -221,7 +226,7 @@ def on_curve(xm: jnp.ndarray, ym: jnp.ndarray) -> jnp.ndarray:
     y2 = mont_sqr(ym, fp)
     x2 = mont_sqr(xm, fp)
     x3 = mont_mul(x2, xm, fp)
-    rhs = add(sub(x3, mul_small(xm, 3)), b_m)
+    rhs = add(sub(x3, mul_small(xm, 3)), const_like(b_m, xm))
     return eq_zero(sub(y2, rhs), fp)
 
 
@@ -234,17 +239,17 @@ def verify_core(e: jnp.ndarray, r: jnp.ndarray, s: jnp.ndarray,
     """Batched ECDSA-P256 verify on raw limb arrays.
 
     Args:
-      e, r, s: (batch, K) canonical limbs — digest (as 256-bit int), and
-        signature scalars already range-checked to [1, n-1] on host.
-      qx, qy: (batch, K) canonical limbs of the affine public key,
+      e, r, s: (K, batch) f32 canonical limbs — digest (as 256-bit int),
+        and signature scalars already range-checked to [1, n-1] on host.
+      qx, qy: (K, batch) f32 canonical limbs of the affine public key,
         host-checked to be < p.
-      rn_lt_p: (batch,) bool — whether r + n < p (precomputed on host;
-        python-int compare, constant-bound).
+      rn_lt_p: (batch,) bool — whether r + n < p (host-precomputed).
     Returns:
       (batch,) bool — signature valid AND key on curve.
     """
-    fp, fn, b_m, gx_m, gy_m = _consts()
-    batch = e.shape[:-1]
+    fp, fn, b_m_np, _, _ = _consts()
+    batch = e.shape[1:]
+    b_m = const_like(b_m_np, e)
 
     # Key checks: on curve, not the identity encoding (0, 0).
     qx_m = to_mont(qx, fp)
@@ -256,25 +261,26 @@ def verify_core(e: jnp.ndarray, r: jnp.ndarray, s: jnp.ndarray,
     # value by a Montgomery-domain one yields a plain product directly.
     s_mn = to_mont(s, fn)
     w_mn = inv_mont(s_mn, fn)
-    u1 = canonical(mont_mul(e, w_mn, fn), fn)
+    u1 = canonical(mont_mul(e, w_mn, fn), fn)       # (K, batch) int32
     u2 = canonical(mont_mul(r, w_mn, fn), fn)
 
-    # WINDOW-bit window values, MSB-window first: (batch, N_WINDOWS).
+    # WINDOW-bit window values, MSB-window first: (N_WINDOWS, batch).
     wexp = jnp.asarray(1 << np.arange(WINDOW), jnp.int32)
+
     def windows_msb_first(u):
-        bits = bits_le(u)                                    # (batch, 256)
-        w = bits.reshape(batch + (N_WINDOWS, WINDOW)) @ wexp # (batch, NW)
-        return w[..., ::-1]
+        bits = bits_le(u)                            # (256, batch)
+        w = jnp.tensordot(
+            wexp, bits.reshape((N_WINDOWS, WINDOW) + batch), axes=(0, 1))
+        return w[::-1]                               # (N_WINDOWS, batch)
+
     u1_w = windows_msb_first(u1)
     u2_w = windows_msb_first(u2)
 
     # Per-lane table [inf, Q, 2Q, ..., 15Q] (projective, Montgomery
     # domain), built on device with 7 doublings + 7 additions; the
     # fixed-base counterpart [inf, G, ..., 15G] is a host-precomputed
-    # shared constant (_g_table) — the windowed split of the reference's
-    # per-signature scalar mult (bccsp/sw/ecdsa.go:41-57 delegates to Go
-    # stdlib; here the ladder IS the hot loop, so the window buys ~1.6x).
-    one_m = jnp.broadcast_to(fp.one_mont, batch + (K,)).astype(jnp.int32)
+    # shared constant (_g_table).
+    one_m = infinity(batch)[1]
     q1 = (qx_m, qy_m, one_m)
     qtab = [infinity(batch), q1]
     for i in range(2, TABLE):
@@ -283,29 +289,32 @@ def verify_core(e: jnp.ndarray, r: jnp.ndarray, s: jnp.ndarray,
         else:
             qtab.append(point_add(qtab[i - 1], q1, fp, b_m))
     q_table = tuple(
-        jnp.stack([pt[c] for pt in qtab], axis=-2)           # (batch, 16, K)
+        jnp.stack([pt[c] for pt in qtab], axis=0)    # (TABLE, K, batch)
         for c in range(3))
-    g_table = tuple(jnp.asarray(_g_table()[c]) for c in range(3))  # (16, K)
+    g_tab_np = _g_table()                            # (3, TABLE, K)
 
     # Windowed Shamir ladder, MSB -> LSB: per step WINDOW doublings,
     # one add from each table (complete addition absorbs the zero-window
     # infinity entries branch-free).
-    sel_seq = jnp.moveaxis(
-        jnp.stack([u1_w, u2_w], axis=-1), -2, 0)             # (NW, batch, 2)
+    sel_seq = jnp.stack([u1_w, u2_w], axis=1)        # (NW, 2, batch)
 
     def step(acc, w2):
         # WINDOW doublings as a fori_loop: the traced scan body holds
         # ONE doubling instead of WINDOW unrolled copies — measurably
-        # faster XLA compiles with identical math
+        # faster XLA compiles with identical math.
         acc = jax.lax.fori_loop(
             0, WINDOW, lambda _i, a: point_double(a, fp, b_m), acc)
-        oh_q = jax.nn.one_hot(w2[..., 1], TABLE, dtype=jnp.int32)
+        # Q-table select: one-hot reduce over the per-lane tables (VPU).
+        oh_q = jax.nn.one_hot(w2[1], TABLE, dtype=jnp.float32, axis=0)
         acc = point_add(acc, tuple(
-            jnp.einsum("...i,...ik->...k", oh_q, q_table[c])
+            jnp.sum(oh_q[:, None] * q_table[c], axis=0)
             for c in range(3)), fp, b_m)
-        oh_g = jax.nn.one_hot(w2[..., 0], TABLE, dtype=jnp.int32)
+        # G-table select: constant table -> one-hot matmul (MXU).
+        # const_dot, NOT a bare tensordot: table limbs reach 511 and
+        # would be rounded by the TPU's default bf16 matmul precision.
+        oh_g = jax.nn.one_hot(w2[0], TABLE, dtype=jnp.float32, axis=0)
         acc = point_add(acc, tuple(
-            jnp.einsum("...i,ik->...k", oh_g, g_table[c])
+            const_dot(g_tab_np[c].T, oh_g)
             for c in range(3)), fp, b_m)
         return acc, None
 
@@ -316,7 +325,7 @@ def verify_core(e: jnp.ndarray, r: jnp.ndarray, s: jnp.ndarray,
     not_inf = ~eq_zero(Z, fp)
     r_m = to_mont(r, fp)
     ok_r = eq_zero(sub(X, mont_mul(r_m, Z, fp)), fp)
-    rn = add(r, jnp.broadcast_to(fn.p, r.shape).astype(jnp.int32))
+    rn = add(r, const_like(fn.p, r))
     rn_m = to_mont(rn, fp)
     ok_rn = eq_zero(sub(X, mont_mul(rn_m, Z, fp)), fp) & rn_lt_p
     return key_ok & not_inf & (ok_r | ok_rn)
@@ -340,6 +349,11 @@ def _lt_bytes(a: np.ndarray, b_: bytes) -> np.ndarray:
     return np.where(any_nz, firstval < 0, False)
 
 
+def _host_limbs(b: np.ndarray) -> np.ndarray:
+    """(batch, 32) bytes -> (K, batch) f32 host array (device layout)."""
+    return np.moveaxis(be_bytes_to_limbs(b), -1, 0).astype(np.float32)
+
+
 def marshal_inputs(digests: np.ndarray, r_bytes: np.ndarray,
                    s_bytes: np.ndarray, qx_bytes: np.ndarray,
                    qy_bytes: np.ndarray):
@@ -347,8 +361,9 @@ def marshal_inputs(digests: np.ndarray, r_bytes: np.ndarray,
     points: range checks + byte->limb marshalling.
 
     Returns (core_args, range_ok): `core_args` is the positional tuple
-    for verify_core (numpy limb arrays + rn_lt_p flags), `range_ok` the
-    host-side scalar-range verdict to AND into the device mask.
+    for verify_core ((K, batch) f32 limb arrays + rn_lt_p flags),
+    `range_ok` the host-side scalar-range verdict to AND into the
+    device mask.
     """
     digests = np.asarray(digests, np.uint8)
     r_bytes = np.asarray(r_bytes, np.uint8)
@@ -363,36 +378,41 @@ def marshal_inputs(digests: np.ndarray, r_bytes: np.ndarray,
                 & _lt_bytes(qx_bytes, _P_BYTES)
                 & _lt_bytes(qy_bytes, _P_BYTES))
     rn_lt_p = _lt_bytes(r_bytes, _P_MINUS_N_BYTES)
-    core_args = (be_bytes_to_limbs(digests), be_bytes_to_limbs(r_bytes),
-                 be_bytes_to_limbs(s_bytes), be_bytes_to_limbs(qx_bytes),
-                 be_bytes_to_limbs(qy_bytes), rn_lt_p)
+    core_args = (_host_limbs(digests), _host_limbs(r_bytes),
+                 _host_limbs(s_bytes), _host_limbs(qx_bytes),
+                 _host_limbs(qy_bytes), rn_lt_p)
     return core_args, range_ok
 
 
 def batch_verify(digests: np.ndarray, r_bytes: np.ndarray,
                  s_bytes: np.ndarray, qx_bytes: np.ndarray,
-                 qy_bytes: np.ndarray, sharding=None) -> np.ndarray:
+                 qy_bytes: np.ndarray, mesh=None) -> np.ndarray:
     """Verify a batch of ECDSA-P256 signatures over 32-byte digests.
 
     All args are (batch, 32) uint8 big-endian.  Returns (batch,) bool.
     Host does only range checks + byte->limb marshalling; all field math
     runs in one jitted device program.
 
-    `sharding` (optional jax.sharding.Sharding over the leading batch
-    axis, see parallel/mesh.py) places the limb arrays across a device
-    mesh before the call, so GSPMD partitions the same jitted program
-    across chips — multi-chip is a data-placement decision, not a
-    different code path.  The batch must then divide the mesh size
-    (every bucket in bccsp/tpu.py does).
+    `mesh` (optional jax.sharding.Mesh, see parallel/mesh.py) shards
+    the trailing batch axis of the limb arrays across the `dp` axis, so
+    GSPMD partitions the same jitted program across chips — multi-chip
+    is a data-placement decision, not a different code path.  The batch
+    must then divide the mesh size (every bucket in bccsp/tpu.py does).
     """
     core_args, range_ok = marshal_inputs(
         digests, r_bytes, s_bytes, qx_bytes, qy_bytes)
 
-    def _dev(x):
+    shardings = (None,) * 6
+    if mesh is not None:
+        from fabric_mod_tpu.parallel import verify_shardings
+        limb_s, flag_s = verify_shardings(mesh)
+        shardings = (limb_s,) * 5 + (flag_s,)
+
+    def _dev(x, s):
         arr = jnp.asarray(x)
-        if sharding is not None:
-            arr = jax.device_put(arr, sharding)
+        if s is not None:
+            arr = jax.device_put(arr, s)
         return arr
 
-    ok = verify_core(*(_dev(a) for a in core_args))
+    ok = verify_core(*(_dev(a, s) for a, s in zip(core_args, shardings)))
     return np.asarray(ok) & range_ok

@@ -5,12 +5,13 @@ The reference's only intra-node parallel axis on the commit path is
 core/committer/txvalidator/v20/validator.go:194-239 and the pool knob
 at core/peer/config.go:255-258).  The TPU-native equivalent (SURVEY.md
 §2.9 row 1) is the batch dimension of the verify kernel, sharded over
-a 1-D `dp` device mesh: inputs are placed with a `NamedSharding` whose
-leading (batch) axis is split across chips, and XLA/GSPMD partitions
-the already-jitted verify program — no per-device code, no collectives
-beyond the final verdict gather, because signature verification is
-embarrassingly parallel across items (SURVEY.md §5.7: batch is the
-only parallel axis; nothing rides ICI except the result).
+a 1-D `dp` device mesh: inputs are placed with `NamedSharding`s that
+split the batch axis across chips — the TRAILING axis of the (K, batch)
+limb arrays, the leading (only) axis of per-item flag vectors — and
+XLA/GSPMD partitions the already-jitted verify program: no per-device
+code, no collectives beyond the final verdict gather, because signature
+verification is embarrassingly parallel across items (SURVEY.md §5.7:
+batch is the only parallel axis; nothing rides ICI except the result).
 
 Multi-host later: the same mesh spec over jax.distributed processes;
 the sharding annotations do not change.
@@ -34,16 +35,19 @@ def data_mesh(n_devices: Optional[int] = None):
     return Mesh(np.array(devs[:n]), ("dp",))
 
 
-def batch_sharding(mesh):
-    """NamedSharding splitting the leading (batch) axis across `dp`.
+def verify_shardings(mesh):
+    """(limb_sharding, flag_sharding) for the verify step's arrays.
 
-    Applies to every per-item array of the verify step: (batch, K)
-    limb arrays and (batch,) flag vectors alike — PartitionSpec("dp")
-    constrains only the leading axis, trailing axes stay replicated.
+    Limb arrays are (K, batch) — the batch is the TRAILING axis
+    (ops/limbs9.py layout), so the limb axis stays replicated and only
+    the batch splits across `dp`; flag vectors are (batch,).  Sharding
+    the limb axis instead would break carries and matmuls into
+    cross-chip traffic — always place limb arrays with the first
+    element of this pair.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    return NamedSharding(mesh, P("dp"))
+    return NamedSharding(mesh, P(None, "dp")), NamedSharding(mesh, P("dp"))
 
 
 def replicated(mesh):

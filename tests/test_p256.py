@@ -13,8 +13,8 @@ import hashlib
 import numpy as np
 import pytest
 
-from fabric_mod_tpu.ops import limbs, p256
-from fabric_mod_tpu.ops.limbs import FieldSpec
+from fabric_mod_tpu.ops import limbs9 as limbs, p256
+from fabric_mod_tpu.ops.limbs9 import FieldSpec, const_like
 
 P, N, B, GX, GY = p256.P, p256.N, p256.B, p256.GX, p256.GY
 
@@ -53,7 +53,7 @@ G = (GX, GY)
 
 
 def to_proj_mont(pt):
-    """Affine python-int point -> Montgomery projective limb arrays."""
+    """Affine python-int point -> Montgomery projective (K,) limb arrays."""
     R = 1 << limbs.RBITS
     if pt is None:
         return (limbs.int_to_limbs(0),
@@ -66,6 +66,7 @@ def to_proj_mont(pt):
 
 
 def from_proj_mont(xyz):
+    """(K,) device limb arrays (one lane) -> affine python-int point."""
     fp = FieldSpec.make("p256.p", P)
     R = 1 << limbs.RBITS
     rinv = pow(R, -1, P)
@@ -87,13 +88,14 @@ def test_point_add_matches_reference(rng):
     cases = [(pts[0], pts[1]), (pts[2], pts[2]),              # generic, double
              (pts[3], None), (None, pts[4]), (None, None),    # identities
              (pts[5], (pts[5][0], P - pts[5][1]))]            # P + (-P)
-    a = tuple(jnp.stack([np.asarray(to_proj_mont(c[0])[i]) for c in cases])
+    # device layout: (K, ncases) — lanes on the trailing axis
+    a = tuple(jnp.stack([to_proj_mont(c[0])[i] for c in cases], axis=-1)
               for i in range(3))
-    b = tuple(jnp.stack([np.asarray(to_proj_mont(c[1])[i]) for c in cases])
+    b = tuple(jnp.stack([to_proj_mont(c[1])[i] for c in cases], axis=-1)
               for i in range(3))
-    out = p256.point_add(a, b, fp, b_m)
+    out = p256.point_add(a, b, fp, const_like(b_m, a[0]))
     for i, (u, v) in enumerate(cases):
-        got = from_proj_mont(tuple(np.asarray(out[c][i]) for c in range(3)))
+        got = from_proj_mont(tuple(np.asarray(out[c][:, i]) for c in range(3)))
         assert got == ref_add(u, v), f"case {i}"
 
 
@@ -101,11 +103,11 @@ def test_point_double_matches_reference(rng):
     import jax.numpy as jnp
     fp, _, b_m, _, _ = p256._consts()
     pts = [ref_mul(rng.randrange(1, N), G) for _ in range(5)] + [None]
-    a = tuple(jnp.stack([np.asarray(to_proj_mont(pt)[i]) for pt in pts])
+    a = tuple(jnp.stack([to_proj_mont(pt)[i] for pt in pts], axis=-1)
               for i in range(3))
-    out = p256.point_double(a, fp, b_m)
+    out = p256.point_double(a, fp, const_like(b_m, a[0]))
     for i, pt in enumerate(pts):
-        got = from_proj_mont(tuple(np.asarray(out[c][i]) for c in range(3)))
+        got = from_proj_mont(tuple(np.asarray(out[c][:, i]) for c in range(3)))
         assert got == ref_add(pt, pt) if pt else got is None, f"case {i}"
 
 
