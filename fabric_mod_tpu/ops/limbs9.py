@@ -215,6 +215,16 @@ for _i in range(K):
     for _j in range(K):
         _COLSUM[_i + _j, _i * K + _j] = 1.0
 
+# Symmetric fold for squaring: upper-triangle products (i <= j), laid
+# out as K concatenated slices [a_i*a_i, a_i*a_{i+1}, ..., a_i*a_{K-1}];
+# cross terms carry weight 2.  K(K+1)/2 = 465 multiplies instead of 900.
+_COLSUM_SQR = np.zeros((2 * K - 1, K * (K + 1) // 2), np.float32)
+_idx = 0
+for _i in range(K):
+    for _j in range(_i, K):
+        _COLSUM_SQR[_i + _j, _idx] = 1.0 if _i == _j else 2.0
+        _idx += 1
+
 
 def sb_mul_cols(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Schoolbook product columns: (K, ...) x (K, ...) -> (2K-1, ...).
@@ -257,8 +267,19 @@ def mont_mul(a: jnp.ndarray, b: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
     return _mont_reduce(carried(sb_mul_cols(a, b)), spec)
 
 
+def sb_sqr_cols(a: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook square columns via the upper triangle: (K, ...) ->
+    (2K-1, ...).  465 multiplies instead of 900 (a_i*a_j == a_j*a_i);
+    the doubling of cross terms lives in the constant fold matrix, so
+    column bounds only double for cross terms: < 2*K*273^2 < 2^22.2 —
+    still exact in f32."""
+    tri = jnp.concatenate([a[i:i + 1] * a[i:] for i in range(K)], axis=0)
+    return const_dot(_COLSUM_SQR, tri)
+
+
 def mont_sqr(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
-    return _mont_reduce(carried(sb_mul_cols(a, a)), spec)
+    """Montgomery square via the symmetric schoolbook (~half the MACs)."""
+    return _mont_reduce(carried(sb_sqr_cols(a)), spec)
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
