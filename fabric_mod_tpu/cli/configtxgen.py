@@ -45,7 +45,9 @@ def make_genesis(profile_path: str, crypto_dir: str) -> "tuple[str, m.Block]":
                                          10 * 1024 * 1024)),
         preferred_max_bytes=int(batch.get("PreferredMaxBytes",
                                           2 * 1024 * 1024)),
-        batch_timeout=str(prof.get("BatchTimeout", "2s")))
+        batch_timeout=str(prof.get("BatchTimeout", "2s")),
+        consensus_type=str(prof.get("ConsensusType", "solo")),
+        consenters=tuple(prof.get("Consenters", []) or ()))
     return channel_id, block
 
 

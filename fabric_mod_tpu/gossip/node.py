@@ -22,7 +22,16 @@ from fabric_mod_tpu.gossip.discovery import Discovery
 from fabric_mod_tpu.gossip.identity import IdentityMapper, pki_id_of
 from fabric_mod_tpu.gossip.protoext import sign_message, verify_envelope
 from fabric_mod_tpu.gossip.state import GossipStateProvider
+from fabric_mod_tpu.observability import MetricOpts, default_provider
 from fabric_mod_tpu.peer.mcs import BlockVerificationError
+
+# Reconciliation backlog (reference: gossip/privdata metrics) — how
+# many committed-without-plaintext digests are still waiting for a
+# peer to supply the data.
+_MISSING_GAUGE = default_provider().new_gauge(MetricOpts(
+    "gossip", "privdata", "reconciliation_backlog",
+    "Missing private-data digests awaiting reconciliation",
+    ("channel",)))
 from fabric_mod_tpu.protos import messages as m
 
 
@@ -252,6 +261,12 @@ class GossipNode:
         if not hasattr(ledger, "missing_pvt"):
             return 0
         missing = ledger.missing_pvt()
+        # backlog visibility: a long outage reconciles at most
+        # `limit` digests per tick — operators need to see the queue
+        # draining
+        if hasattr(ledger, "missing_pvt_count"):
+            _MISSING_GAUGE.with_labels(
+                self._channel.channel_id).set(ledger.missing_pvt_count())
         if not missing:
             return 0
         digests = [m.PvtDataDigest(block_num=bn, tx_num=tn,

@@ -464,6 +464,11 @@ class KvLedger:
         if len(purge_batch):
             self.state.apply_updates(purge_batch, num)
         self._pvtstore.purge(num)
+        # ONE durability barrier for the whole block's private data —
+        # per-collection fsyncs would multiply commit latency by the
+        # number of collections (the blockstore also syncs per block)
+        if hasattr(self._pvtstore, "sync"):
+            self._pvtstore.sync()
 
     # -- reconciliation (reference: gossip/privdata/reconcile.go:339) ----
     def get_pvt(self, block_num: int, tx_num: int):
@@ -473,6 +478,14 @@ class KvLedger:
         if self._pvtstore is None:
             return []
         return self._pvtstore.get(block_num, tx_num)
+
+    def missing_pvt_count(self) -> int:
+        """Total reconciliation backlog (exported as a gauge by the
+        gossip reconciler — the 'is the queue draining?' signal)."""
+        if self._pvtstore is None or not hasattr(self._pvtstore,
+                                                 "missing_count"):
+            return 0
+        return self._pvtstore.missing_count()
 
     def missing_pvt(self, limit: int = 50):
         """Unreconciled (block, tx, ns, collection) digests, dropping
@@ -608,6 +621,10 @@ class KvLedger:
                 self.history.close()
             else:
                 self.state.snapshot(self._state_path)
+            # attached pvt/transient stores may hold open op-logs
+            for store in (self._transient, self._pvtstore):
+                if store is not None and hasattr(store, "close"):
+                    store.close()
             self.blockstore.close()
 
 

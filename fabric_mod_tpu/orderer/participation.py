@@ -196,8 +196,15 @@ class ChannelParticipation:
             raise ParticipationError(f"unknown channel {channel_id!r}")
         chain = support.chain
         status = FOLLOWER if isinstance(chain, FollowerChain) else ACTIVE
-        return {"name": channel_id, "height": support.store.height,
+        info = {"name": channel_id, "height": support.store.height,
                 "status": status}
+        # consensus leadership, when the consenter knows it (raft):
+        # operators and the process-network harness use this to find
+        # the node to kill/drain (reference: channelparticipation's
+        # consensusRelation field)
+        if hasattr(chain, "is_leader"):
+            info["is_leader"] = bool(chain.is_leader)
+        return info
 
     # -- join / remove ----------------------------------------------------
     def join(self, join_block: m.Block, as_follower: bool = False):

@@ -44,8 +44,10 @@ class OrdererServer:
     def start(self) -> None:
         self._grpc.start()
 
-    def stop(self) -> None:
-        self._grpc.stop()
+    def stop(self, grace: float = 1.0) -> None:
+        """`grace=0` aborts in-flight streams immediately (crash
+        simulation in tests); the default drains them briefly."""
+        self._grpc.stop(grace)
 
     # -- Broadcast stream (reference: broadcast.go:66) -------------------
     def _handle_broadcast(self, request_iter, context) -> Iterator[bytes]:

@@ -1,0 +1,260 @@
+"""Deliver failover: rotate across orderer endpoints with backoff.
+
+(reference: internal/pkg/peer/blocksprovider/blocksprovider.go
+`DeliverBlocks` — the retry loop with exponential backoff at :141 —
+plus internal/pkg/peer/orderers/connection.go's endpoint source.)
+
+`FailoverDeliverSource` has the same ``blocks()`` generator contract as
+the in-process DeliverService and the single-endpoint
+GrpcDeliverSource, so DeliverClient stays transport-agnostic.  What it
+adds:
+
+* a LIST of orderer endpoints, tried round-robin; a stream that ends
+  (disconnect, terminal status) moves to the next endpoint and re-seeks
+  from the next block the caller still needs — the caller sees one
+  uninterrupted, gap-free block sequence;
+* exponential backoff between full rotations (every endpoint failed),
+  so a fully-down ordering service costs sleep, not spin;
+* `report_bad_block(n)`: the caller's verify stage (MCS) flags a block
+  that failed verification; the source re-fetches from `n` on a
+  DIFFERENT orderer instead of the caller halting commit forever — the
+  reference's "disconnect and try another orderer" stance
+  (blocksprovider.go:227 VerifyBlock error path).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterator, List, Optional, Sequence
+
+from fabric_mod_tpu.comm.grpc_comm import GRPCClient
+from fabric_mod_tpu.observability import get_logger
+from fabric_mod_tpu.orderer.server import SERVICE, make_seek_envelope
+from fabric_mod_tpu.protos import messages as m
+
+log = get_logger("peer.blocksprovider")
+
+
+class Endpoint:
+    """One orderer address + its TLS material (lazy-dialed)."""
+
+    def __init__(self, address: str,
+                 server_root_pem: Optional[bytes] = None,
+                 client_cert_pem: Optional[bytes] = None,
+                 client_key_pem: Optional[bytes] = None,
+                 override_authority: Optional[str] = None):
+        self.address = address
+        self._tls = (server_root_pem, client_cert_pem, client_key_pem,
+                     override_authority)
+        self._client: Optional[GRPCClient] = None
+
+    def client(self) -> GRPCClient:
+        if self._client is None:
+            root, cert, key, auth = self._tls
+            self._client = GRPCClient(self.address, server_root_pem=root,
+                                      client_cert_pem=cert,
+                                      client_key_pem=key,
+                                      override_authority=auth)
+        return self._client
+
+    def reset(self) -> None:
+        """Drop the cached channel (a dead connection must not be
+        reused after its orderer restarts)."""
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+
+class FailoverDeliverSource:
+    """Multi-orderer deliver stream with rotation + backoff."""
+
+    def __init__(self, endpoints: Sequence[Endpoint], channel_id: str,
+                 base_backoff_s: float = 0.1, max_backoff_s: float = 10.0):
+        if not endpoints:
+            raise ValueError("at least one orderer endpoint required")
+        self._endpoints: List[Endpoint] = list(endpoints)
+        self._channel_id = channel_id
+        self._base = base_backoff_s
+        self._max = max_backoff_s
+        self._idx = 0                      # current endpoint
+        self._resume: Optional[int] = None  # set by report_bad_block
+        self._lock = threading.Lock()
+        self.rotations = 0                 # observability
+
+    def report_bad_block(self, number: int) -> None:
+        """The caller's verify stage rejected block `number`: re-fetch
+        it from a different orderer (fail-closed per orderer, not
+        forever)."""
+        with self._lock:
+            self._resume = number
+        log.warning("block %d failed verification; rotating orderer",
+                    number)
+
+    def _rotate(self) -> None:
+        with self._lock:
+            self._endpoints[self._idx].reset()
+            self._idx = (self._idx + 1) % len(self._endpoints)
+            self.rotations += 1
+
+    def current_address(self) -> str:
+        with self._lock:
+            return self._endpoints[self._idx].address
+
+    def blocks(self, start: int = 0, stop: Optional[int] = None,
+               stop_event: Optional[threading.Event] = None,
+               timeout_s: float = 30.0) -> Iterator[m.Block]:
+        """Yield blocks [start, stop] in order, failing over as needed.
+
+        Ends only when `stop` is reached or `stop_event` fires (an
+        endless peer stream passes stop=None and stops via the event).
+        `timeout_s` bounds ONE quiet stream — a source that hangs
+        without closing is treated as failed and rotated away from.
+        """
+        import grpc
+
+        next_needed = start
+        consecutive_failures = 0
+        while not (stop_event is not None and stop_event.is_set()):
+            if stop is not None and next_needed > stop:
+                return
+            ep = self._endpoints[self._idx]
+            made_progress = False
+            try:
+                seek = make_seek_envelope(self._channel_id, next_needed,
+                                          stop)
+                stream = ep.client().stream_stream(
+                    SERVICE, "Deliver", iter([seek.encode()]),
+                    timeout=None)
+                try:
+                    watchdog = _StreamWatchdog(stream, timeout_s,
+                                               stop_event)
+                    for raw in watchdog.iterate():
+                        resp = m.DeliverResponse.decode(raw)
+                        if resp.block is None:
+                            break          # terminal status
+                        blk = resp.block
+                        if blk.header.number != next_needed:
+                            # gap or replay: this orderer is not
+                            # serving what we asked — rotate
+                            log.warning(
+                                "orderer %s sent block %d, wanted %d",
+                                ep.address, blk.header.number,
+                                next_needed)
+                            break
+                        yield blk
+                        # a yield only counts as PROGRESS if the
+                        # caller's verify stage did not immediately
+                        # reject it — otherwise N orderers all serving
+                        # an unverifiable block would rotate in a hot
+                        # loop with the backoff never engaging
+                        with self._lock:
+                            if self._resume is not None:
+                                next_needed = self._resume
+                                self._resume = None
+                                break      # rotate below
+                            next_needed = blk.header.number + 1
+                        made_progress = True
+                        consecutive_failures = 0
+                        if stop_event is not None and stop_event.is_set():
+                            return
+                        if stop is not None and next_needed > stop:
+                            return
+                finally:
+                    watchdog.abandon()
+                    stream.cancel()
+            except grpc.RpcError as e:
+                log.info("deliver stream to %s failed: %s", ep.address,
+                         getattr(e, "code", lambda: e)())
+            self._rotate()
+            if not made_progress:
+                consecutive_failures += 1
+                if consecutive_failures >= len(self._endpoints):
+                    # full rotation without progress: back off
+                    # (exponent clamped — a multi-hour outage must not
+                    # overflow the float and kill the deliver thread)
+                    exp = min(30, consecutive_failures
+                              - len(self._endpoints))
+                    delay = min(self._max, self._base * (2 ** exp))
+                    if stop_event is not None:
+                        if stop_event.wait(delay):
+                            return
+                    else:
+                        time.sleep(delay)
+
+
+class _StreamWatchdog:
+    """Bounds the gap between stream messages: a stream that stalls
+    longer than `timeout_s` without closing is abandoned (cancel) so
+    the caller can rotate — gRPC's own keepalive only detects dead
+    TCP, not a live-but-silent orderer."""
+
+    _DONE = object()
+    _POLL_S = 0.5                         # stop_event responsiveness
+
+    def __init__(self, stream, timeout_s: float,
+                 stop_event: Optional[threading.Event]):
+        self._stream = stream
+        self._timeout = timeout_s
+        self._stop_event = stop_event
+        self._abandoned = threading.Event()
+
+    def abandon(self) -> None:
+        """Unblock the pump thread (it must never stay parked in
+        q.put after the consumer walks away — that would leak one
+        thread per rotation)."""
+        self._abandoned.set()
+
+    def iterate(self):
+        import queue as _queue
+        q: "_queue.Queue" = _queue.Queue(8)
+
+        def pump():
+            try:
+                for item in self._stream:
+                    while not self._abandoned.is_set():
+                        try:
+                            q.put(item, timeout=0.5)
+                            break
+                        except _queue.Full:
+                            continue
+                    if self._abandoned.is_set():
+                        return
+            except Exception:
+                pass
+            while not self._abandoned.is_set():
+                try:
+                    q.put(self._DONE, timeout=0.5)
+                    return
+                except _queue.Full:
+                    continue
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        try:
+            waited = 0.0
+            while True:
+                # short polls so a stop_event (peer shutdown) is seen
+                # within _POLL_S even under a very long idle timeout
+                try:
+                    item = q.get(timeout=min(self._POLL_S,
+                                             self._timeout))
+                except _queue.Empty:
+                    if (self._stop_event is not None
+                            and self._stop_event.is_set()):
+                        self._stream.cancel()
+                        return
+                    waited += self._POLL_S
+                    if waited >= self._timeout:
+                        self._stream.cancel()  # silent stream: abandon
+                        return
+                    continue
+                if item is self._DONE:
+                    return
+                waited = 0.0
+                yield item
+                if (self._stop_event is not None
+                        and self._stop_event.is_set()):
+                    self._stream.cancel()
+                    return
+        finally:
+            self.abandon()

@@ -58,11 +58,22 @@ class Channel:
         self._vinfo = vinfo
         self.mcs = MessageCryptoService(self.bundle, verifier)
         # private data plumbing (reference: transientstore + the
-        # privdata coordinator wiring of peer.go createChannel)
+        # privdata coordinator wiring of peer.go createChannel); on a
+        # durable ledger both stores are durable too — committed
+        # private plaintext and the pending-reconciliation index
+        # survive restarts (reference: pvtdatastorage/store.go,
+        # transientstore/store.go are leveldb instances)
+        import os as _os
         from fabric_mod_tpu.ledger.pvtdata import (
             PvtDataStore, TransientStore)
-        self.transient_store = TransientStore()
-        self.pvtdata_store = PvtDataStore()
+        pvt_root = (ledger.dir if getattr(ledger, "_durable", False)
+                    else None)
+        self.transient_store = TransientStore(
+            dir_path=(_os.path.join(pvt_root, "transient")
+                      if pvt_root else None))
+        self.pvtdata_store = PvtDataStore(
+            dir_path=(_os.path.join(pvt_root, "pvtdata")
+                      if pvt_root else None))
         self.ledger.attach_pvt(self.transient_store, self.pvtdata_store,
                                self._collection_btl)
         self._install_bundle(bundle)

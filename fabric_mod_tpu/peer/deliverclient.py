@@ -92,10 +92,19 @@ class DeliverClient:
                         self._channel.channel_id, block,
                         expected_prev_hash=prev_hash)
                 except BlockVerificationError:
-                    # tampered/mis-signed block: drop it, do not commit
-                    # (reference: blocksprovider err path — disconnect
-                    # and retry another orderer; in-process we stop)
+                    # tampered/mis-signed block: drop it, never commit.
+                    # With a failover source, ask it to re-fetch this
+                    # block from a DIFFERENT orderer and keep pulling
+                    # (reference: blocksprovider.go:227 — disconnect
+                    # and retry another orderer); a single-endpoint
+                    # source fails closed by stopping.
                     self.rejected.append(block.header.number)
+                    del self.rejected[:-1000]      # bounded memory
+                    report = getattr(self._source, "report_bad_block",
+                                     None)
+                    if report is not None:
+                        report(block.header.number)
+                        continue
                     break
                 prev_hash = protoutil.block_header_hash(block.header)
                 self._q.put(block)

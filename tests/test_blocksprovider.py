@@ -1,0 +1,161 @@
+"""Deliver failover: endpoint rotation, mid-stream death, bad blocks.
+
+(reference test model: internal/pkg/peer/blocksprovider suites — the
+retry/failover loop — with real gRPC servers in-process.)
+"""
+import threading
+import time
+
+import pytest
+
+from fabric_mod_tpu.comm.grpc_comm import GRPCClient
+from fabric_mod_tpu.e2e import Network
+from fabric_mod_tpu.orderer.server import OrdererServer
+from fabric_mod_tpu.peer.blocksprovider import (
+    Endpoint, FailoverDeliverSource)
+from fabric_mod_tpu.peer.deliverclient import DeliverClient
+from fabric_mod_tpu.protos import messages as m
+
+
+@pytest.fixture()
+def net(tmp_path):
+    n = Network(str(tmp_path), batch_timeout="100ms",
+                max_message_count=5)
+    yield n
+    n.close()
+
+
+def _wait(pred, t=20.0):
+    deadline = time.time() + t
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TamperingOrdererServer(OrdererServer):
+    """Serves real blocks with corrupted metadata signatures from
+    block `tamper_from` on — an orderer whose responses fail MCS."""
+
+    def __init__(self, registrar, tamper_from: int = 1, **kw):
+        super().__init__(registrar, **kw)
+        self._tamper_from = tamper_from
+
+    def _handle_deliver(self, request_iter, context):
+        for raw in super()._handle_deliver(request_iter, context):
+            resp = m.DeliverResponse.decode(raw)
+            if (resp.block is not None
+                    and resp.block.header.number >= self._tamper_from
+                    and resp.block.metadata is not None
+                    and resp.block.metadata.metadata):
+                md = list(resp.block.metadata.metadata)
+                md[0] = b"\x00" * max(1, len(md[0]))
+                resp.block.metadata.metadata = md
+                yield resp.encode()
+            else:
+                yield raw
+
+
+def test_rotation_after_mid_stream_server_death(net):
+    """Kill the serving orderer mid-stream: the source rotates to the
+    second endpoint and the peer commits every tx with no gap."""
+    srv_a = OrdererServer(net.registrar, "127.0.0.1:0")
+    srv_b = OrdererServer(net.registrar, "127.0.0.1:0")
+    srv_a.start()
+    srv_b.start()
+    try:
+        source = FailoverDeliverSource(
+            [Endpoint(f"127.0.0.1:{srv_a.port}"),
+             Endpoint(f"127.0.0.1:{srv_b.port}")],
+            net.channel_id, base_backoff_s=0.05)
+        dc = DeliverClient(net.channel, source)
+        t = threading.Thread(target=lambda: dc.run(idle_timeout_s=5.0),
+                             daemon=True)
+        t.start()
+
+        for i in range(10):
+            net.invoke([b"put", b"fk%d" % i, b"fv%d" % i])
+        assert _wait(lambda: net.ledger.height >= 3), "no commits at all"
+        srv_a.stop(grace=0)                # mid-stream death (abort)
+        for i in range(10, 20):
+            net.invoke([b"put", b"fk%d" % i, b"fv%d" % i])
+        ok = _wait(lambda: sum(
+            len(net.ledger.get_block_by_number(n).data.data)
+            for n in range(1, net.ledger.height)) >= 20)
+        assert ok, f"height {net.ledger.height}, " \
+                   f"rotations {source.rotations}"
+        assert source.rotations >= 1
+        qe = net.ledger.new_query_executor()
+        assert qe.get_state("mycc", "fk15") == b"fv15"
+        dc.stop()
+        t.join(timeout=5)
+    finally:
+        srv_b.stop()
+
+
+def test_bad_block_rotates_instead_of_halting(net):
+    """A tampered block from one orderer must not halt commit forever:
+    the client reports it, the source re-fetches the same block from
+    the next endpoint, commit proceeds (reference:
+    blocksprovider.go:227 VerifyBlock error -> disconnect/retry)."""
+    evil = TamperingOrdererServer(net.registrar, tamper_from=1,
+                                  address="127.0.0.1:0")
+    good = OrdererServer(net.registrar, "127.0.0.1:0")
+    evil.start()
+    good.start()
+    try:
+        source = FailoverDeliverSource(
+            [Endpoint(f"127.0.0.1:{evil.port}"),
+             Endpoint(f"127.0.0.1:{good.port}")],
+            net.channel_id, base_backoff_s=0.05)
+        dc = DeliverClient(net.channel, source)
+        t = threading.Thread(target=lambda: dc.run(idle_timeout_s=5.0),
+                             daemon=True)
+        t.start()
+        for i in range(8):
+            net.invoke([b"put", b"bk%d" % i, b"bv%d" % i])
+        ok = _wait(lambda: sum(
+            len(net.ledger.get_block_by_number(n).data.data)
+            for n in range(1, net.ledger.height)) >= 8)
+        assert ok, (f"height {net.ledger.height}, rejected "
+                    f"{dc.rejected}, rotations {source.rotations}")
+        assert dc.rejected, "evil orderer was never even consulted"
+        assert source.rotations >= 1
+        dc.stop()
+        t.join(timeout=5)
+    finally:
+        evil.stop()
+        good.stop()
+
+
+def test_all_endpoints_down_backs_off_then_recovers(net):
+    """With every orderer down the source backs off (no spin); when one
+    comes back the stream resumes from the needed height."""
+    srv = OrdererServer(net.registrar, "127.0.0.1:0")
+    port = srv.port
+    # not started yet: both endpoints dead
+    source = FailoverDeliverSource(
+        [Endpoint(f"127.0.0.1:{port}")],
+        net.channel_id, base_backoff_s=0.05, max_backoff_s=0.2)
+    got = []
+    stop = threading.Event()
+
+    def pull():
+        for blk in source.blocks(0, stop=None, stop_event=stop,
+                                 timeout_s=2.0):
+            got.append(blk.header.number)
+
+    t = threading.Thread(target=pull, daemon=True)
+    t.start()
+    time.sleep(0.5)
+    assert not got
+    srv.start()
+    try:
+        net.invoke([b"put", b"rk", b"rv"])
+        assert _wait(lambda: len(got) >= 2), got   # genesis + block 1
+        assert got == sorted(got)
+        stop.set()
+        t.join(timeout=5)
+    finally:
+        srv.stop()

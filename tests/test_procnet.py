@@ -1,0 +1,326 @@
+"""Process-level network tier: real OS processes, raft leader kill.
+
+(reference test model: integration/nwo/network.go:44-60 — the network
+builder that spawns real peer/orderer binaries — and the CFT suite
+integration/raft/cft_test.go:47 that kills the leader and watches the
+network keep ordering.)
+
+Topology: 3 raft orderers + 2 committing peers, every node its own OS
+process (`fabric-mod-tpu node --role orderer|peer`), crypto from the
+cryptogen CLI, genesis from the configtxgen CLI, TLS on the
+broadcast/deliver and cluster listeners.  The test submits txs, kills
+the raft LEADER with SIGKILL, and asserts both peers keep committing
+through the deliver-failover path.
+"""
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from fabric_mod_tpu.comm.tls import TlsCA
+from fabric_mod_tpu.comm.grpc_comm import GRPCClient
+from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder
+from fabric_mod_tpu.peer.grpcdeliver import GrpcBroadcaster
+from fabric_mod_tpu.protos import protoutil
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _http_json(url, timeout=2.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _metric_value(url, name, timeout=2.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        text = r.read().decode()
+    vals = [float(line.rsplit(" ", 1)[1])
+            for line in text.splitlines()
+            if line.startswith(name) and not line.startswith("#")]
+    return max(vals) if vals else None
+
+
+def _wait(pred, t=30.0, dt=0.25):
+    deadline = time.time() + t
+    while time.time() < deadline:
+        try:
+            if pred():
+                return True
+        except Exception:
+            pass
+        time.sleep(dt)
+    return False
+
+
+class ProcNet:
+    """The nwo-style process-network builder."""
+
+    def __init__(self, root):
+        self.root = str(root)
+        self.procs = {}
+        self.logs = {}
+        self.tls = TlsCA()
+        self.o_ids = ["o0", "o1", "o2"]
+        (self.bport0, self.bport1, self.bport2,
+         self.cport0, self.cport1, self.cport2,
+         self.oops0, self.oops1, self.oops2,
+         self.pops0, self.pops1) = _free_ports(11)
+        self.bports = dict(zip(self.o_ids,
+                               (self.bport0, self.bport1, self.bport2)))
+        self.cports = dict(zip(self.o_ids,
+                               (self.cport0, self.cport1, self.cport2)))
+        self.oops = dict(zip(self.o_ids,
+                             (self.oops0, self.oops1, self.oops2)))
+        self.pops = {"p0": self.pops0, "p1": self.pops1}
+        self._build_artifacts()
+
+    # -- artifacts (cryptogen + configtxgen + TLS) ------------------------
+    def _build_artifacts(self):
+        from fabric_mod_tpu.cli.cryptogen import main as cryptogen_main
+        from fabric_mod_tpu.cli.configtxgen import main as configtxgen_main
+        import yaml
+
+        crypto_conf = os.path.join(self.root, "crypto.yaml")
+        with open(crypto_conf, "w") as f:
+            yaml.safe_dump({
+                "PeerOrgs": [
+                    {"Name": "Org1", "PeerCount": 1, "UserCount": 1},
+                    {"Name": "Org2", "PeerCount": 1, "UserCount": 1},
+                ],
+                "OrdererOrgs": [{"Name": "OrdererOrg",
+                                 "OrdererCount": 3}],
+            }, f)
+        self.crypto_dir = os.path.join(self.root, "crypto")
+        assert cryptogen_main(["--config", crypto_conf,
+                               "--output", self.crypto_dir]) == 0
+
+        profile = os.path.join(self.root, "configtx.yaml")
+        with open(profile, "w") as f:
+            yaml.safe_dump({
+                "ChannelID": "procchan",
+                "PeerOrgs": ["Org1", "Org2"],
+                "OrdererOrgs": ["OrdererOrg"],
+                "ConsensusType": "etcdraft",
+                "Consenters": self.o_ids,
+                "BatchTimeout": "250ms",
+                "BatchSize": {"MaxMessageCount": 5},
+            }, f)
+        self.genesis = os.path.join(self.root, "genesis.block")
+        assert configtxgen_main(["--profile", profile,
+                                 "--crypto", self.crypto_dir,
+                                 "--output", self.genesis]) == 0
+
+        # TLS: one CA; per-orderer server+client pairs; peers get ca.crt
+        for oid in self.o_ids:
+            d = os.path.join(self.root, "tls", oid)
+            os.makedirs(d)
+            scert, skey = self.tls.issue(
+                f"{oid}.example.com",
+                sans=(f"{oid}.example.com", "localhost", "127.0.0.1"))
+            ccert, ckey = self.tls.issue(f"{oid}.client", server=False)
+            for name, data in (("ca.crt", self.tls.cert_pem),
+                               ("server.crt", scert), ("server.key", skey),
+                               ("client.crt", ccert), ("client.key", ckey)):
+                with open(os.path.join(d, name), "wb") as f:
+                    f.write(data)
+        d = os.path.join(self.root, "tls", "peer")
+        os.makedirs(d)
+        with open(os.path.join(d, "ca.crt"), "wb") as f:
+            f.write(self.tls.cert_pem)
+
+    # -- process control ---------------------------------------------------
+    def _spawn(self, name, args, ops_port):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        env["JAX_PLATFORMS"] = "cpu"
+        # PeerConfig env overrides (config.py ENV_PREFIX="CORE")
+        env["CORE_LISTENADDRESS"] = f"127.0.0.1:{ops_port}"
+        env["CORE_BCCSP_DEFAULT"] = "SW"
+        log = open(os.path.join(self.root, f"{name}.log"), "wb")
+        self.logs[name] = log
+        self.procs[name] = subprocess.Popen(
+            [sys.executable, "-m", "fabric_mod_tpu.cli.main",
+             "node"] + args,
+            env=env, stdout=log, stderr=log, cwd=self.root)
+
+    def start_orderer(self, oid):
+        cluster_peers = ",".join(
+            f"{j}=127.0.0.1:{self.cports[j]}" for j in self.o_ids)
+        self._spawn(oid, [
+            "--role", "orderer", "--id", oid,
+            "--genesis", self.genesis, "--crypto", self.crypto_dir,
+            "--orderer-org", "OrdererOrg",
+            "--data", os.path.join(self.root, "data", oid),
+            "--listen", f"127.0.0.1:{self.bports[oid]}",
+            "--cluster-listen", f"127.0.0.1:{self.cports[oid]}",
+            "--cluster-peers", cluster_peers,
+            "--tls-dir", os.path.join(self.root, "tls", oid),
+        ], self.oops[oid])
+
+    def start_peer(self, pid, org):
+        orderers = ",".join(f"127.0.0.1:{self.bports[j]}"
+                            for j in self.o_ids)
+        self._spawn(pid, [
+            "--role", "peer", "--org", org,
+            "--genesis", self.genesis, "--crypto", self.crypto_dir,
+            "--data", os.path.join(self.root, "data", pid),
+            "--orderers", orderers,
+            "--tls-dir", os.path.join(self.root, "tls", "peer"),
+        ], self.pops[pid])
+
+    def start_all(self):
+        for oid in self.o_ids:
+            self.start_orderer(oid)
+        for pid, org in (("p0", "Org1"), ("p1", "Org2")):
+            self.start_peer(pid, org)
+
+    def kill(self, name, sig=signal.SIGKILL):
+        p = self.procs[name]
+        p.send_signal(sig)
+        p.wait(timeout=15)
+
+    def teardown(self):
+        for name, p in self.procs.items():
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+        for log in self.logs.values():
+            log.close()
+
+    # -- observation -------------------------------------------------------
+    def orderer_channels(self, oid):
+        return _http_json(
+            f"http://127.0.0.1:{self.oops[oid]}"
+            "/participation/v1/channels")
+
+    def leader(self):
+        for oid in self.o_ids:
+            if self.procs[oid].poll() is not None:
+                continue
+            try:
+                chans = self.orderer_channels(oid)["channels"]
+            except Exception:
+                continue
+            if any(c.get("is_leader") for c in chans):
+                return oid
+        return None
+
+    def peer_height(self, pid):
+        return _metric_value(
+            f"http://127.0.0.1:{self.pops[pid]}/metrics",
+            "ledger_blockchain_height")
+
+    # -- client ------------------------------------------------------------
+    def _identity(self, org, kind, name):
+        from cryptography import x509
+        from fabric_mod_tpu.bccsp.sw import SwCSP
+        from fabric_mod_tpu.msp.identities import SigningIdentity
+        base = os.path.join(self.crypto_dir, org)
+        with open(os.path.join(base, kind, f"{name}.pem"), "rb") as f:
+            cert = x509.load_pem_x509_certificate(f.read())
+        with open(os.path.join(base, kind, f"{name}.key"), "rb") as f:
+            key_pem = f.read()
+        return SigningIdentity(org, cert, key_pem, SwCSP())
+
+    def broadcaster(self, oid):
+        client = GRPCClient(
+            f"127.0.0.1:{self.bports[oid]}",
+            server_root_pem=self.tls.cert_pem,
+            override_authority=f"{oid}.example.com")
+        return client, GrpcBroadcaster(client)
+
+    def submit_txs(self, oid, start, count):
+        """Submit `count` put-txs endorsed by Org1+Org2 peers (the
+        MAJORITY of the two application orgs)."""
+        client_id = self._identity("Org1", "users", "user0")
+        endorsers = [self._identity("Org1", "peers", "peer0"),
+                     self._identity("Org2", "peers", "peer0")]
+        conn, bcast = self.broadcaster(oid)
+        try:
+            for i in range(start, start + count):
+                b = RWSetBuilder()
+                b.add_write("mycc", f"pk{i}", b"pv%d" % i)
+                env = protoutil.create_signed_tx(
+                    "procchan", "mycc", b.build().encode(), client_id,
+                    endorsers)
+                bcast.submit(env)
+        finally:
+            bcast.close()
+            conn.close()
+
+
+@pytest.fixture()
+def procnet(tmp_path):
+    net = ProcNet(tmp_path)
+    yield net
+    net.teardown()
+
+
+def test_process_network_survives_leader_kill(procnet):
+    """The headline CFT scenario across 5 OS processes: order txs,
+    SIGKILL the raft leader, keep ordering; both peers commit every tx
+    through deliver failover."""
+    net = procnet
+    net.start_all()
+
+    # all orderers up with the channel, a leader elected
+    assert _wait(lambda: all(
+        net.orderer_channels(o)["channels"][0]["height"] >= 1
+        for o in net.o_ids), t=60), "orderers did not come up"
+    assert _wait(lambda: net.leader() is not None, t=60), \
+        "no raft leader elected"
+    # both peers committed genesis
+    assert _wait(lambda: all(net.peer_height(p) >= 1
+                             for p in ("p0", "p1")), t=60), \
+        "peers did not bootstrap"
+
+    # phase 1: txs through a follower (tests submit forwarding too)
+    leader = net.leader()
+    follower = next(o for o in net.o_ids if o != leader)
+    net.submit_txs(follower, 0, 6)
+    # 6 txs / MaxMessageCount 5 -> at least 2 blocks past genesis
+    assert _wait(lambda: all((net.peer_height(p) or 0) >= 3
+                             for p in ("p0", "p1")), t=60), (
+        "peers did not commit phase-1 txs: heights "
+        f"{[net.peer_height(p) for p in ('p0', 'p1')]}")
+
+    # phase 2: SIGKILL the leader, the network must re-elect and keep
+    # ordering, peers must keep committing (deliver failover if they
+    # were streaming from the dead node)
+    leader = net.leader()
+    net.kill(leader)
+    survivors = [o for o in net.o_ids if o != leader]
+    assert _wait(lambda: net.leader() in survivors, t=90), \
+        "no re-election after leader SIGKILL"
+    net.submit_txs(net.leader(), 6, 6)
+    h0 = net.peer_height("p0")
+    assert _wait(lambda: all((net.peer_height(p) or 0) >= (h0 or 1) + 1
+                             for p in ("p0", "p1")), t=90), (
+        "peers did not commit after leader kill: heights "
+        f"{[net.peer_height(p) for p in ('p0', 'p1')]}")
+
+    # every orderer process left alive is at the same height
+    heights = {o: net.orderer_channels(o)["channels"][0]["height"]
+               for o in survivors}
+    assert _wait(lambda: len({
+        net.orderer_channels(o)["channels"][0]["height"]
+        for o in survivors}) == 1, t=30), f"divergent heights {heights}"

@@ -171,3 +171,96 @@ def test_pvtdata_store_expiry_bookkeeping():
     assert store.expiring_at(14)          # 10 + 3 + 1
     store.purge(14)
     assert store.get(10, 0) == []
+
+
+# --- durability (reference: leveldb-backed pvtdatastorage + transient
+# store — both survive restarts; here the op-log + checkpoint pattern) ---
+
+def _mk_pvt_rwset(ns, coll, key, val):
+    kv = m.KVRWSet(writes=[m.KVWrite(key=key, value=val)])
+    return m.TxPvtReadWriteSet(ns_pvt_rwset=[
+        m.NsPvtReadWriteSet(namespace=ns, collection_pvt_rwset=[
+            m.CollectionPvtReadWriteSet(collection_name=coll,
+                                        rwset=kv.encode())])])
+
+
+def test_transient_store_survives_restart(tmp_path):
+    d = str(tmp_path / "transient")
+    ts = TransientStore(dir_path=d)
+    ts.persist("tx1", 5, _mk_pvt_rwset("cc", "col", "k1", b"v1"))
+    ts.persist("tx2", 9, _mk_pvt_rwset("cc", "col", "k2", b"v2"))
+    ts.purge_by_txids(["tx1"])
+    # crash: reopen WITHOUT close (appends are flushed per record)
+    ts2 = TransientStore(dir_path=d)
+    assert ts2.get_by_txid("tx1") == []
+    got = ts2.get_by_txid("tx2")
+    assert len(got) == 1
+    assert got[0].ns_pvt_rwset[0].namespace == "cc"
+    # purge below height also replays
+    ts2.purge_below_height(10)
+    ts3 = TransientStore(dir_path=d)
+    assert ts3.get_by_txid("tx2") == []
+    ts.close(); ts2.close(); ts3.close()
+
+
+def test_pvtdata_store_survives_restart(tmp_path):
+    d = str(tmp_path / "pvt")
+    kv = m.KVRWSet(writes=[m.KVWrite(key="pk", value=b"pv")])
+    st = PvtDataStore(dir_path=d)
+    st.commit(4, 0, "cc", "col", kv, btl=3)
+    st.report_missing(4, 1, "cc", "col2")
+    st.report_missing(5, 0, "cc", "col")
+    st.drop_missing(5, 0, "cc", "col")
+    # crash-reopen: committed plaintext AND the reconciliation
+    # backlog survive
+    st2 = PvtDataStore(dir_path=d)
+    got = st2.get(4, 0)
+    assert [(n, c, k.writes[0].key) for n, c, k in got] == \
+        [("cc", "col", "pk")]
+    assert st2.missing() == [(4, 1, "cc", "col2")]
+    assert st2.missing_count() == 1
+    # BTL expiry bookkeeping survives too: purge at expiry block
+    assert st2.expiring_at(8) != []
+    st2.purge(8)
+    st3 = PvtDataStore(dir_path=d)
+    assert st3.get(4, 0) == []
+    st.close(); st2.close(); st3.close()
+
+
+def test_pvtdata_checkpoint_compacts_log(tmp_path):
+    import os
+    d = str(tmp_path / "pvt")
+    st = PvtDataStore(dir_path=d)
+    st._log.CKPT_EVERY = 10               # force frequent checkpoints
+    kv = m.KVRWSet(writes=[m.KVWrite(key="k", value=b"v")])
+    for i in range(35):
+        st.commit(i, 0, "cc", "col", kv, btl=0)
+    files = os.listdir(d)
+    assert any("ckpt" in f for f in files), files
+    st2 = PvtDataStore(dir_path=d)
+    assert len([1 for i in range(35) if st2.get(i, 0)]) == 35
+    st.close(); st2.close()
+
+
+def test_channel_private_plaintext_survives_reopen(net):
+    """The e2e stance: commit private data through the channel on a
+    durable ledger, then reopen the channel's pvt store from disk —
+    the committed plaintext is still there (reference: pvtdatastorage
+    survives restarts)."""
+    net.invoke([b"putpvt", b"col1", b"acct"],
+               transient={"value": b"durable-secret"})
+    assert _commit_all(net, 1) == 1
+    # the channel must have wired a DURABLE store (net's ledger is)
+    assert net.channel.pvtdata_store._log is not None
+    entries = [(bn, tn) for bn in range(1, net.ledger.height)
+               for tn in range(8)
+               if net.channel.pvtdata_store.get(bn, tn)]
+    assert entries, "no private data committed through the channel"
+    # crash-reopen the store directory with a fresh instance
+    import os
+    d = os.path.join(net.ledger.dir, "pvtdata")
+    reopened = PvtDataStore(dir_path=d)
+    bn, tn = entries[0]
+    got = reopened.get(bn, tn)
+    assert got and got[0][2].writes[0].value == b"durable-secret"
+    reopened.close()
