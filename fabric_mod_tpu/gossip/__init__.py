@@ -4,6 +4,9 @@ in-order state transfer into the commit pipeline."""
 from fabric_mod_tpu.gossip.comm import GossipComm, InProcNetwork  # noqa: F401
 from fabric_mod_tpu.gossip.discovery import Discovery             # noqa: F401
 from fabric_mod_tpu.gossip.identity import IdentityMapper         # noqa: F401
+from fabric_mod_tpu.gossip.election import (                      # noqa: F401
+    LeaderElectionService)
 from fabric_mod_tpu.gossip.node import GossipNode                 # noqa: F401
+from fabric_mod_tpu.gossip.service import GossipService           # noqa: F401
 from fabric_mod_tpu.gossip.state import (                         # noqa: F401
     GossipStateProvider, PayloadsBuffer)

@@ -21,6 +21,39 @@ from fabric_mod_tpu.protos import messages as m
 Handler = Callable[[bytes, bytes], None]     # (src_pki_id, envelope bytes)
 
 
+class GossipAuth:
+    """Connection-authentication hooks for the gRPC gossip transport
+    (reference: comm_impl.go:411 authenticateRemotePeer — the signed
+    TLS-binding handshake that ties a connection to an MSP identity).
+
+    `identity`: this node's serialized MSP identity;
+    `sign(data)`: signature by that identity's key;
+    `validate(identity_bytes) -> pki_id`: MSP-validate a remote
+    identity (raise on invalid) — wire to IdentityMapper.put;
+    `verify(pki_id, data, sig) -> bool` — wire to IdentityMapper.verify.
+    """
+
+    def __init__(self, identity: bytes, sign, validate, verify):
+        self.identity = identity
+        self.sign = sign
+        self.validate = validate
+        self.verify = verify
+
+
+_HSK_CTX = b"gossip-handshake-v1\x00"
+
+
+def _pem_cert_der_hash(pem: bytes) -> bytes:
+    """Stable digest of a TLS certificate: hash the DER, not the PEM
+    (PEM wrapping differs between the client's file and the server's
+    re-encoded auth_context view)."""
+    import hashlib as _hl
+    from cryptography import x509
+    from cryptography.hazmat.primitives.serialization import Encoding
+    cert = x509.load_pem_x509_certificate(pem)
+    return _hl.sha256(cert.public_bytes(Encoding.DER)).digest()
+
+
 class InProcNetwork:
     """Endpoint registry + direct delivery (the wire stand-in)."""
 
@@ -69,13 +102,26 @@ class GRPCGossipNetwork:
     SERVICE = ("Gossip", "Message")
     QUEUE_CAP = 256
 
+    SERVICE_CONNECT = ("Gossip", "Connect")
+    NONCE_TTL_S = 30.0
+    SESSION_TTL_S = 3600.0
+    SESSION_CAP = 4096
+
     def __init__(self, listen_address: str = "127.0.0.1:0",
                  server_cert: Optional[bytes] = None,
                  server_key: Optional[bytes] = None,
                  client_ca: Optional[bytes] = None,
                  client_cert: Optional[bytes] = None,
                  client_key: Optional[bytes] = None,
-                 send_timeout_s: float = 1.5):
+                 send_timeout_s: float = 1.5,
+                 auth: Optional[GossipAuth] = None):
+        """With `auth`, every connection must complete the signed
+        handshake before Message RPCs are accepted: the remote signs
+        (context ‖ server nonce ‖ its TLS client-cert digest), the
+        server checks the digest against the cert actually presented
+        on THIS connection and MSP-validates the identity.  Messages
+        are then attributed to the HANDSHAKE identity — a claimed
+        sender that differs from the authenticated one is dropped."""
         import base64
         import json
         import queue
@@ -88,11 +134,17 @@ class GRPCGossipNetwork:
         self._GRPCClient = GRPCClient
         self._client_tls = (client_ca, client_cert, client_key)
         self._timeout = send_timeout_s
+        self._auth = auth
+        self._my_tls_hash = (_pem_cert_der_hash(client_cert)
+                             if client_cert is not None else b"")
         self._lock = threading.Lock()
         self._stopped = threading.Event()
         self._handlers: Dict[str, Handler] = {}
         self._clients: Dict[str, object] = {}
         self._queues: Dict[str, object] = {}
+        self._tokens: Dict[str, str] = {}        # dst endpoint -> token
+        self._nonces: Dict[str, float] = {}      # minted nonce -> expiry
+        self._sessions: Dict[str, tuple] = {}    # token -> (pki, tlshash)
         self.partitioned: set = set()      # honored like InProcNetwork
         self.server = GRPCServer(listen_address,
                                  server_cert_pem=server_cert,
@@ -102,6 +154,8 @@ class GRPCGossipNetwork:
         self.listen_endpoint = f"{host}:{self.server.port}"
         self.server.register(*self.SERVICE, MethodKind.UNARY,
                              self._on_message)
+        self.server.register(*self.SERVICE_CONNECT, MethodKind.UNARY,
+                             self._on_connect)
 
     def start(self) -> None:
         self.server.start()
@@ -177,13 +231,139 @@ class GRPCGossipNetwork:
             if payload is None or self._stopped.is_set():
                 return
             try:
-                self._client_for(endpoint).unary(
-                    *self.SERVICE, payload, timeout=self._timeout)
+                resp = self._send_one(endpoint, payload)
+                if resp == b"NACK" and self._auth is not None:
+                    # receiver restarted and lost our session: drop
+                    # the cached token, re-handshake, retry once
+                    with self._lock:
+                        self._tokens.pop(endpoint, None)
+                    self._send_one(endpoint, payload)
             except Exception:
                 with self._lock:
                     client = self._clients.pop(endpoint, None)
+                    self._tokens.pop(endpoint, None)
                 if client is not None:
                     client.close()
+
+    def _send_one(self, endpoint: str, payload: bytes) -> bytes:
+        if self._auth is not None:
+            token = self._token_for(endpoint)
+            d = self._json.loads(payload)
+            d["token"] = token
+            payload = self._json.dumps(d).encode()
+        return self._client_for(endpoint).unary(
+            *self.SERVICE, payload, timeout=self._timeout)
+
+    # -- client side of the handshake -------------------------------------
+    def _token_for(self, endpoint: str) -> str:
+        with self._lock:
+            token = self._tokens.get(endpoint)
+        if token is not None:
+            return token
+        client = self._client_for(endpoint)
+        hello = self._json.loads(client.unary(
+            *self.SERVICE_CONNECT,
+            self._json.dumps({"phase": "hello"}).encode(),
+            timeout=self._timeout))
+        nonce = self._unb64(hello["nonce"])
+        sig = self._auth.sign(_HSK_CTX + nonce + self._my_tls_hash)
+        resp = self._json.loads(client.unary(
+            *self.SERVICE_CONNECT,
+            self._json.dumps({
+                "phase": "auth",
+                "nonce": hello["nonce"],
+                "identity": self._b64(self._auth.identity).decode(),
+                "tls": self._b64(self._my_tls_hash).decode(),
+                "sig": self._b64(sig).decode()}).encode(),
+            timeout=self._timeout))
+        token = resp["token"]
+        with self._lock:
+            self._tokens[endpoint] = token
+        return token
+
+    # -- server side of the handshake --------------------------------------
+    _CERT_HASH_CACHE_MAX = 256
+
+    def _peer_cert_hash(self, context) -> bytes:
+        """DER digest of the TLS client certificate actually presented
+        on this connection ('' without mTLS).  Cached by PEM bytes —
+        this runs on the per-message hot path and the ASN.1 parse is
+        constant per peer."""
+        try:
+            auth = context.auth_context()
+            pems = auth.get("x509_pem_cert") or []
+            if pems:
+                pem = pems[0]
+                cache = getattr(self, "_cert_hash_cache", None)
+                if cache is None:
+                    cache = self._cert_hash_cache = {}
+                h = cache.get(pem)
+                if h is None:
+                    if len(cache) >= self._CERT_HASH_CACHE_MAX:
+                        cache.clear()
+                    h = cache[pem] = _pem_cert_der_hash(pem)
+                return h
+        except Exception:
+            pass
+        return b""
+
+    def _on_connect(self, request: bytes, context) -> bytes:
+        import os as _os
+        import time as _time
+        if self._auth is None:
+            return self._json.dumps({"error": "auth not enabled"}).encode()
+        try:
+            d = self._json.loads(request)
+            if d.get("phase") == "hello":
+                nonce = _os.urandom(16)
+                with self._lock:
+                    now = _time.time()
+                    self._nonces = {n: exp for n, exp in
+                                    self._nonces.items() if exp > now}
+                    self._nonces[self._b64(nonce).decode()] = \
+                        now + self.NONCE_TTL_S
+                return self._json.dumps(
+                    {"nonce": self._b64(nonce).decode()}).encode()
+            # phase: auth
+            nonce_b64 = d["nonce"]
+            with self._lock:
+                exp = self._nonces.pop(nonce_b64, None)
+            if exp is None or exp < _time.time():
+                return self._json.dumps(
+                    {"error": "unknown or expired nonce"}).encode()
+            identity = self._unb64(d["identity"])
+            claimed_tls = self._unb64(d["tls"])
+            sig = self._unb64(d["sig"])
+            actual_tls = self._peer_cert_hash(context)
+            if claimed_tls != actual_tls:
+                # the signed TLS binding does not match the cert on
+                # THIS connection: a replayed/stolen handshake
+                return self._json.dumps(
+                    {"error": "tls binding mismatch"}).encode()
+            pki = self._auth.validate(identity)   # raises on invalid
+            nonce = self._unb64(nonce_b64)
+            if not self._auth.verify(pki, _HSK_CTX + nonce +
+                                     claimed_tls, sig):
+                return self._json.dumps(
+                    {"error": "bad handshake signature"}).encode()
+            token = self._b64(_os.urandom(16)).decode()
+            now = _time.time()
+            with self._lock:
+                # sessions are TTL'd and capped: every valid MSP
+                # member can mint them, so unbounded growth would be
+                # a slow memory DoS
+                self._sessions = {
+                    t: s for t, s in self._sessions.items()
+                    if s[2] > now}
+                while len(self._sessions) >= self.SESSION_CAP:
+                    oldest = min(self._sessions,
+                                 key=lambda t: self._sessions[t][2])
+                    del self._sessions[oldest]
+                self._sessions[token] = (pki, actual_tls,
+                                         now + self.SESSION_TTL_S)
+            return self._json.dumps({"token": token}).encode()
+        except Exception as e:
+            return self._json.dumps({"error": str(e)}).encode()
 
     def _client_for(self, endpoint: str):
         with self._lock:
@@ -201,10 +381,32 @@ class GRPCGossipNetwork:
     def _on_message(self, request: bytes, context) -> bytes:
         try:
             d = self._json.loads(request)
+            claimed_pki = self._unb64(d["pki"])
+            if self._auth is not None:
+                import time as _time
+                now = _time.time()
+                with self._lock:
+                    session = self._sessions.get(d.get("token", ""))
+                if session is None or session[2] < now:
+                    # unknown/expired token (e.g. we restarted and
+                    # lost the session): NACK so the sender
+                    # re-handshakes instead of blackholing forever
+                    return b"NACK"
+                auth_pki, bound_tls, _exp = session
+                # the token is bound to the TLS client cert it was
+                # minted under — a stolen token dies with its session
+                if bound_tls != self._peer_cert_hash(context):
+                    return b""
+                # a claimed sender that is not the authenticated
+                # connection identity is exactly the org-A-TLS/
+                # org-B-signature confusion the handshake exists to
+                # stop (reference: comm_impl.go:411)
+                if claimed_pki != auth_pki:
+                    return b""
             with self._lock:
                 handler = self._handlers.get(d["dst"])
             if handler is not None:
-                handler(self._unb64(d["pki"]), self._unb64(d["env"]))
+                handler(claimed_pki, self._unb64(d["env"]))
         except Exception:
             pass
         return b""

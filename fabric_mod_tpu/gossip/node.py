@@ -14,7 +14,6 @@ from __future__ import annotations
 import math
 import random
 import threading
-from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from fabric_mod_tpu.gossip.comm import GossipComm, InProcNetwork
@@ -58,13 +57,12 @@ class GossipNode:
             self._identity, self.comm)
         self.state = GossipStateProvider(
             channel, request_missing=self._pull_range)
-        self._seen_lock = threading.Lock()
-        # bounded message store: FIFO eviction stands in for the
-        # reference's TTL'd store (gossip msgstore) — unbounded growth
-        # is a leak at sustained gossip rates
-        self._seen_nonces: set = set()
-        self._seen_order: "deque[int]" = deque()
-        self.seen_cap = 100_000
+        # TTL'd duplicate suppression (reference: gossip msgstore) —
+        # an entry is suppressed for exactly the TTL regardless of
+        # arrival rate; a 200k-message burst cannot evict entries
+        # seen moments earlier the way the old FIFO cap could
+        from fabric_mod_tpu.gossip.msgstore import TTLMessageStore
+        self._seen = TTLMessageStore(ttl_s=120.0)
         network.register(endpoint, self.on_message)
 
     # -- outbound ---------------------------------------------------------
@@ -92,15 +90,8 @@ class GossipNode:
         self.comm.broadcast(self._pick_peers(), msg)
 
     def _remember_nonce(self, nonce: int) -> bool:
-        """Record a nonce; False when already seen.  Bounded FIFO."""
-        with self._seen_lock:
-            if nonce in self._seen_nonces:
-                return False
-            self._seen_nonces.add(nonce)
-            self._seen_order.append(nonce)
-            while len(self._seen_order) > self.seen_cap:
-                self._seen_nonces.discard(self._seen_order.popleft())
-            return True
+        """Record a nonce; False when already seen within the TTL."""
+        return self._seen.check_and_add(nonce)
 
     def join(self, bootstrap_endpoints: List[str]) -> None:
         """Announce ourselves to bootstrap peers."""

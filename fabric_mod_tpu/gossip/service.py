@@ -1,0 +1,118 @@
+"""Gossip service binding: election-driven deliver ownership.
+
+(reference: gossip/service/gossip_service.go:556 — InitializeChannel
+hands the deliver client to the leader-election service so exactly ONE
+peer per org pulls from the ordering service while the others receive
+blocks via gossip state transfer; leadership changes start/stop the
+client.)
+
+Composition per channel:
+
+  LeaderElectionService (over discovery's alive view)
+        │ on_change(is_leader)
+        ▼
+  DeliverClient(channel, deliver_source)   — started when elected
+        │ on_commit(block)
+        ▼
+  GossipNode.gossip_block                  — epidemic fan-out to the
+                                             non-leaders' state buffers
+
+A demoted leader stops its client; a promoted peer starts one from the
+channel's current height.  Non-leaders commit through the gossip state
+provider (in-order payload buffer + anti-entropy), so a leader crash
+costs one election interval, not a stalled channel.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from fabric_mod_tpu.gossip.election import LeaderElectionService
+from fabric_mod_tpu.observability import get_logger
+from fabric_mod_tpu.peer.deliverclient import DeliverClient
+
+log = get_logger("gossip.service")
+
+
+class GossipService:
+    """One channel's gossip + election + deliver composition."""
+
+    def __init__(self, node, deliver_source_factory: Callable[[], object],
+                 static_leader: Optional[bool] = None,
+                 election_interval_s: float = 0.5):
+        """`node`: a started GossipNode.  `deliver_source_factory`:
+        () -> a deliver source (FailoverDeliverSource in production,
+        the in-process DeliverService in tests); called fresh on every
+        promotion so a returning leader re-dials.  `static_leader`
+        pins leadership (reference: the static org-leader mode)."""
+        self._node = node
+        self._factory = deliver_source_factory
+        self._interval = election_interval_s
+        self._client: Optional[DeliverClient] = None
+        self._client_thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.election = LeaderElectionService(
+            node.pki_id,
+            lambda: [mb.pki_id for mb in node.discovery.alive_members()],
+            on_change=self._on_leadership,
+            static=static_leader)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.election.is_leader
+
+    def start(self) -> None:
+        # the state provider's drain/anti-entropy loop is what turns a
+        # NON-leader's gossip receipts into commits — the service owns
+        # it so every composed peer commits regardless of leadership
+        self._node.state.start()
+        self.election.start(self._interval)
+        self.election.tick()               # immediate first verdict
+        # the static-leader path never fires on_change (leadership is
+        # fixed from construction) — start the client directly
+        if self.election.is_leader:
+            self._start_client()
+
+    def stop(self) -> None:
+        self.election.stop()
+        self._stop_client()
+        self._node.state.stop()
+
+    # -- leadership transitions -------------------------------------------
+    def _on_leadership(self, is_leader: bool) -> None:
+        if is_leader:
+            log.info("%s: elected deliver leader", self._node.endpoint)
+            self._start_client()
+        else:
+            log.info("%s: demoted from deliver leadership",
+                     self._node.endpoint)
+            self._stop_client()
+
+    def _start_client(self) -> None:
+        with self._lock:
+            if self._client is not None:
+                return
+            channel = self._node._channel
+            client = DeliverClient(
+                channel, self._factory(),
+                on_commit=self._node.gossip_block)
+            self._client = client
+
+            def run():
+                try:
+                    client.run(idle_timeout_s=3600.0)
+                except Exception as e:     # pragma: no cover
+                    log.warning("deliver client died: %s", e)
+
+            t = threading.Thread(target=run, daemon=True)
+            self._client_thread = t
+            t.start()
+
+    def _stop_client(self) -> None:
+        with self._lock:
+            client, self._client = self._client, None
+            thread, self._client_thread = self._client_thread, None
+        if client is not None:
+            client.stop()
+        if thread is not None:
+            thread.join(timeout=10)

@@ -94,6 +94,10 @@ class GossipStateProvider:
 
     # -- background mode --------------------------------------------------
     def start(self, interval_s: float = 0.05) -> None:
+        """Idempotent: a second start() (e.g. two services composed
+        over one node) does not spawn a second drain loop."""
+        if self._thread is not None and self._thread.is_alive():
+            return
         def loop():
             while not self._stop.wait(interval_s):
                 self.drain()

@@ -165,6 +165,12 @@ class FailoverDeliverSource:
             except grpc.RpcError as e:
                 log.info("deliver stream to %s failed: %s", ep.address,
                          getattr(e, "code", lambda: e)())
+            except Exception as e:
+                # anything else a bad orderer can induce (garbage
+                # frames failing DeliverResponse.decode, ...) must
+                # rotate, not kill the peer's deliver thread
+                log.warning("deliver stream to %s raised: %r",
+                            ep.address, e)
             self._rotate()
             if not made_progress:
                 consecutive_failures += 1

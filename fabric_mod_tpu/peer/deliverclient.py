@@ -39,12 +39,17 @@ class DeliverClient:
 
     def __init__(self, channel: Channel, source,
                  queue_size: int = 8,
-                 on_error: Optional[Callable[[Exception], None]] = None):
+                 on_error: Optional[Callable[[Exception], None]] = None,
+                 on_commit: Optional[Callable[[m.Block], None]] = None):
+        """`on_commit(block)` fires after each commit — the gossip
+        service uses it to fan committed blocks out to non-leader
+        peers (reference: the leader's gossip of deliver payloads)."""
         self._channel = channel
         self._source = source
         self._q: "queue.Queue[Optional[m.Block]]" = queue.Queue(queue_size)
         self._stop = threading.Event()
         self._on_error = on_error
+        self._on_commit = on_commit
         self.rejected: List[int] = []      # block numbers that failed MCS
         self._commit_err: Optional[Exception] = None
         self._committed = threading.Condition()
@@ -67,6 +72,11 @@ class DeliverClient:
             with self._committed:
                 self._height = block.header.number + 1
                 self._committed.notify_all()
+            if self._on_commit is not None:
+                try:
+                    self._on_commit(block)
+                except Exception:          # gossip fan-out is advisory
+                    pass
 
     # -- stage 1: pull + verify ------------------------------------------
     def run(self, stop_at: Optional[int] = None,
