@@ -114,7 +114,9 @@ class Network:
         from fabric_mod_tpu.peer.scc import CsccContract, QsccContract
         self.chaincodes = ChaincodeRegistry()
         self.chaincodes.register("mycc", KvContract())
-        self.chaincodes.register(LIFECYCLE_NS, LifecycleContract())
+        self.chaincodes.register(LIFECYCLE_NS, LifecycleContract(
+            channel_orgs=lambda: list(
+                self.channel.bundle().application.org_mspids)))
         self.chaincodes.register("qscc", QsccContract(self.ledger))
         self.chaincodes.register("cscc", CsccContract(self.channel))
         self.endorsers: Dict[str, Endorser] = {
@@ -125,12 +127,78 @@ class Network:
     # -- client operations ------------------------------------------------
     def invoke(self, args: Sequence[bytes],
                endorsing_orgs: Optional[Sequence[str]] = None,
-               chaincode: str = "mycc", transient=None) -> str:
+               chaincode: str = "mycc", transient=None,
+               signer=None) -> str:
         orgs = list(endorsing_orgs or list(self.endorsers)[:2])
         return endorse_and_submit(
-            self.channel_id, chaincode, args, self.client,
+            self.channel_id, chaincode, args, signer or self.client,
             [self.endorsers[o] for o in orgs], self.broadcast,
             transient=transient)
+
+    def pump_committed(self, want_txs: int, timeout: float = 30.0
+                       ) -> int:
+        """Run a deliver client until `want_txs` total txs committed."""
+        import threading as _th
+        client = self.deliver_client()
+        t = _th.Thread(target=lambda: client.run(idle_timeout_s=5.0),
+                       daemon=True)
+        t.start()
+        deadline = time.time() + timeout
+        committed = 0
+        while time.time() < deadline:
+            committed = sum(
+                len(self.ledger.get_block_by_number(i).data.data)
+                for i in range(1, self.ledger.height))
+            if committed >= want_txs:
+                break
+            time.sleep(0.02)
+        client.stop()
+        t.join(timeout=5)
+        return committed
+
+    def deploy_chaincode(self, name: str, version: str, sequence: int,
+                         policy: bytes = b"", collections: bytes = b"",
+                         approving_orgs: Optional[Sequence[str]] = None
+                         ) -> int:
+        """The full lifecycle ceremony (reference: approve-per-org ->
+        commit): each approving org's ADMIN submits an approval
+        endorsed by its OWN peer (org-local act), the approvals
+        commit, then the commit op (endorsed by a majority) commits.
+        Returns the total committed tx count afterwards."""
+        from fabric_mod_tpu.peer.lifecycle import LIFECYCLE_NS
+        orgs = list(approving_orgs
+                    or list(self.endorsers)[:len(self.endorsers) // 2
+                                            + 1])
+        base = sum(len(self.ledger.get_block_by_number(i).data.data)
+                   for i in range(1, self.ledger.height))
+        args = [b"approve", name.encode(), version.encode(),
+                str(sequence).encode(), policy, collections]
+        txids = []
+        for org in orgs:
+            txids.append(self.invoke(args, endorsing_orgs=[org],
+                                     chaincode=LIFECYCLE_NS,
+                                     signer=self.admins[org]))
+        got = self.pump_committed(base + len(orgs))
+        if got < base + len(orgs):
+            raise RuntimeError(
+                f"approvals did not commit ({got}/{base + len(orgs)})")
+        txids.append(self.invoke(
+            [b"commit", name.encode(), version.encode(),
+             str(sequence).encode(), policy, collections],
+            chaincode=LIFECYCLE_NS))
+        got = self.pump_committed(base + len(orgs) + 1)
+        if got < base + len(orgs) + 1:
+            raise RuntimeError("definition commit did not commit")
+        # every ceremony tx must have VALIDATED — checked by txid, not
+        # by block position (unrelated txs may share our blocks)
+        for txid in txids:
+            pt = self.ledger.get_transaction_by_id(txid)
+            if pt is None or pt.validation_code != \
+                    m.TxValidationCode.VALID:
+                raise RuntimeError(
+                    f"lifecycle tx {txid} invalid "
+                    f"({None if pt is None else pt.validation_code})")
+        return got
 
     def deliver_client(self, **kw) -> DeliverClient:
         return DeliverClient(self.channel, self.deliver, **kw)

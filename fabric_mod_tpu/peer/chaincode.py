@@ -24,7 +24,8 @@ class ChaincodeStub:
 
     def __init__(self, namespace: str, simulator, args: List[bytes],
                  txid: str, channel_id: str,
-                 transient: Optional[Dict[str, bytes]] = None):
+                 transient: Optional[Dict[str, bytes]] = None,
+                 creator: bytes = b""):
         self.namespace = namespace
         self._sim = simulator
         self.args = args
@@ -33,6 +34,16 @@ class ChaincodeStub:
         # side-channel inputs; never part of the ordered tx
         # (reference: the shim's GetTransient)
         self.transient = dict(transient or {})
+        # serialized creator identity (reference: shim GetCreator)
+        self.creator = creator
+
+    def creator_mspid(self) -> str:
+        """MSP id of the proposal creator ('' when unavailable)."""
+        from fabric_mod_tpu.protos import messages as _m
+        try:
+            return _m.SerializedIdentity.decode(self.creator).mspid
+        except Exception:
+            return ""
 
     def get_state(self, key: str) -> Optional[bytes]:
         return self._sim.get_state(self.namespace, key)

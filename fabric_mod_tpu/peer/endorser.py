@@ -114,7 +114,7 @@ class Endorser:
         sim = self._channel.ledger.new_tx_simulator(ch.tx_id)
         stub = ChaincodeStub(ns, sim, args, ch.tx_id,
                              self._channel.channel_id,
-                             transient=transient)
+                             transient=transient, creator=sh.creator)
         try:
             result = self._registry.execute(ns, stub)
             rwset = sim.done()

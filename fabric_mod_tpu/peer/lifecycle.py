@@ -1,12 +1,25 @@
-"""Chaincode lifecycle: committed definitions drive validation info.
+"""Chaincode lifecycle: org approvals + committed definitions.
 
 (reference: core/chaincode/lifecycle — the `_lifecycle` system
-chaincode (scc.go:911) whose committed definitions the plugin
-dispatcher resolves per namespace (plugindispatcher/dispatcher.go:102,
-deployedcc_infoprovider.go ValidationInfo).  The approve/commit
-two-step collapses to one `commit` op here; the org-approval policy
-gate is the channel's LifecycleEndorsement policy enforced by the
-normal endorsement path, exactly like the reference.)
+chaincode: ApproveChaincodeDefinitionForMyOrg + CheckCommitReadiness +
+CommitChaincodeDefinition at scc.go:911, approval bookkeeping at
+lifecycle.go:770; committed definitions feed the plugin dispatcher
+(plugindispatcher/dispatcher.go:102) per namespace.)
+
+The governance ceremony: each org APPROVES the exact definition
+parameters (a digest of version/sequence/policy/collections recorded
+under `approvals/<cc>/<seq>/<mspid>`); COMMIT succeeds only when the
+approvals of a MAJORITY of the channel's application orgs match the
+committed parameters — the multi-party upgrade gate the reference
+enforces through the LifecycleEndorsement policy.
+
+Validation subtlety mirrored from the reference: an APPROVE tx is an
+org-local act — it is endorsed by ONE org and validated against that
+org's own Endorsement policy (the reference stores approvals in the
+org's implicit collection, validated org-locally).  Commit and every
+other `_lifecycle` write validate against LifecycleEndorsement.
+`LifecycleValidationInfo.validation_info_for_writes` implements the
+split by inspecting the tx's written keys.
 
 A definition lives in the `_lifecycle` state namespace under
 `namespaces/<cc>`; because it arrives via an ordinary endorsed tx, it
@@ -16,48 +29,158 @@ cache (state reads are cheap here).
 """
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+import hashlib
+import json
+import re
+from typing import Callable, List, Optional, Tuple
 
 from fabric_mod_tpu.peer.chaincode import ChaincodeError, ChaincodeStub
 from fabric_mod_tpu.protos import messages as m
 
 LIFECYCLE_NS = "_lifecycle"
 
+_APPROVAL_RE = re.compile(r"^approvals/([^/]+)/(\d+)/([^/]+)$")
+
 
 def definition_key(cc_name: str) -> str:
     return f"namespaces/{cc_name}"
 
 
-class LifecycleContract:
-    """The `_lifecycle` system chaincode: args
-    [op, name, ...]; ops: commit(name, version, sequence,
-    endorsement_policy_bytes), query(name)."""
+def approval_key(cc_name: str, sequence: int, mspid: str) -> str:
+    return f"approvals/{cc_name}/{sequence}/{mspid}"
 
+
+def _param_digest(version: str, sequence: int, policy: bytes,
+                  collections: bytes) -> bytes:
+    """Approvals bind to the EXACT definition parameters: an org that
+    approved (v1, policyA) has not approved (v1, policyB)."""
+    h = hashlib.sha256()
+    for part in (version.encode(), str(sequence).encode(), policy,
+                 collections):
+        h.update(len(part).to_bytes(4, "big"))
+        h.update(part)
+    return h.digest()
+
+
+class LifecycleContract:
+    """The `_lifecycle` system chaincode.
+
+    args [op, ...]; ops:
+      approve(name, version, sequence, policy, collections) — record
+        THIS org's approval (org = tx creator's MSP);
+      checkcommitreadiness(name, version, sequence, policy,
+        collections) -> JSON {org: approved};
+      commit(name, version, sequence, policy, collections) — requires
+        matching approvals from a majority of channel orgs;
+      queryapproved(name, sequence) -> creator org's approval digest;
+      query(name) -> committed definition bytes.
+
+    `channel_orgs`: () -> [mspid] of the channel's application orgs
+    (wired from the channel bundle).  Without it the contract runs in
+    single-step dev mode: commit needs no approvals (in-process tools
+    and bare unit tests)."""
+
+    def __init__(self, channel_orgs: Optional[Callable[[], List[str]]]
+                 = None):
+        self._channel_orgs = channel_orgs
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _def_args(stub: ChaincodeStub):
+        name = stub.args[1].decode()
+        version = stub.args[2].decode()
+        sequence = int(stub.args[3].decode())
+        policy = stub.args[4] if len(stub.args) > 4 else b""
+        collections = stub.args[5] if len(stub.args) > 5 else b""
+        if collections:                     # must decode as a package
+            m.CollectionConfigPackage.decode(collections)
+        if "/" in name:
+            raise ChaincodeError(f"invalid chaincode name {name!r}")
+        return name, version, sequence, policy, collections
+
+    def _check_sequence(self, stub: ChaincodeStub, name: str,
+                        sequence: int) -> None:
+        prev = stub.get_state(definition_key(name))
+        prev_seq = (m.ChaincodeDefinition.decode(prev).sequence
+                    if prev else 0)
+        if sequence != prev_seq + 1:
+            raise ChaincodeError(
+                f"definition sequence {sequence} != expected "
+                f"{prev_seq + 1}")
+
+    def _approvals(self, stub: ChaincodeStub, name: str, sequence: int,
+                   digest: bytes):
+        """{org: approved_matching} over the channel's orgs."""
+        orgs = list(self._channel_orgs()) if self._channel_orgs else []
+        out = {}
+        for org in orgs:
+            got = stub.get_state(approval_key(name, sequence, org))
+            out[org] = bool(got) and got == digest
+        return out
+
+    # -- dispatch ------------------------------------------------------------
     def invoke(self, stub: ChaincodeStub) -> bytes:
         if not stub.args:
             raise ChaincodeError("no args")
         op = stub.args[0].decode()
-        if op == "commit":
+
+        if op == "approve":
+            # (reference: ApproveChaincodeDefinitionForMyOrg) — the
+            # approving org is the tx CREATOR's org; the key embeds it
+            # so one org can never write another org's approval, and
+            # validation pins this tx to that org's Endorsement policy
+            name, version, sequence, policy, collections = \
+                self._def_args(stub)
+            mspid = stub.creator_mspid()
+            if not mspid:
+                raise ChaincodeError("approve: no creator identity")
+            self._check_sequence(stub, name, sequence)
+            stub.put_state(
+                approval_key(name, sequence, mspid),
+                _param_digest(version, sequence, policy, collections))
+            return b"ok"
+
+        if op == "checkcommitreadiness":
+            # (reference: CheckCommitReadiness, scc.go)
+            name, version, sequence, policy, collections = \
+                self._def_args(stub)
+            digest = _param_digest(version, sequence, policy,
+                                   collections)
+            ready = self._approvals(stub, name, sequence, digest)
+            return json.dumps(ready, sort_keys=True).encode()
+
+        if op == "queryapproved":
+            # (reference: QueryApprovedChaincodeDefinition)
             name = stub.args[1].decode()
-            version = stub.args[2].decode()
-            sequence = int(stub.args[3].decode())
-            policy = stub.args[4] if len(stub.args) > 4 else b""
-            collections = stub.args[5] if len(stub.args) > 5 else b""
-            if collections:                 # must decode as a package
-                m.CollectionConfigPackage.decode(collections)
-            prev = stub.get_state(definition_key(name))
-            prev_seq = (m.ChaincodeDefinition.decode(prev).sequence
-                        if prev else 0)
-            if sequence != prev_seq + 1:
-                raise ChaincodeError(
-                    f"definition sequence {sequence} != expected "
-                    f"{prev_seq + 1}")
+            sequence = int(stub.args[2].decode())
+            mspid = stub.creator_mspid()
+            got = stub.get_state(approval_key(name, sequence, mspid))
+            return got.hex().encode() if got else b""
+
+        if op == "commit":
+            name, version, sequence, policy, collections = \
+                self._def_args(stub)
+            self._check_sequence(stub, name, sequence)
+            if self._channel_orgs is not None:
+                digest = _param_digest(version, sequence, policy,
+                                       collections)
+                ready = self._approvals(stub, name, sequence, digest)
+                yes = sum(ready.values())
+                # MAJORITY of application orgs (the channel default
+                # LifecycleEndorsement rule)
+                need = len(ready) // 2 + 1
+                if yes < need:
+                    raise ChaincodeError(
+                        f"commit of {name!r} sequence {sequence}: "
+                        f"approvals {yes}/{len(ready)} "
+                        f"(need {need}): {ready}")
             d = m.ChaincodeDefinition(
                 sequence=sequence, version=version,
                 endorsement_policy=policy, validation_plugin="vscc",
                 collections=collections)
             stub.put_state(definition_key(name), d.encode())
             return b"ok"
+
         if op == "query":
             raw = stub.get_state(definition_key(stub.args[1].decode()))
             return raw if raw is not None else b""
@@ -69,7 +192,10 @@ class LifecycleValidationInfo:
     (reference: plugindispatcher dispatcher.go:102 + the lifecycle
     ValidatorCommitter).  Falls back to the channel default policy for
     undefined namespaces — and for `_lifecycle` itself, which is
-    governed by /Channel/Application/LifecycleEndorsement."""
+    governed by /Channel/Application/LifecycleEndorsement, EXCEPT
+    org-local approval writes, which validate against that single
+    org's Endorsement policy (the reference's implicit-collection
+    validation split)."""
 
     def __init__(self, state_get: Callable[[str, str], Optional[bytes]],
                  default_policy: bytes,
@@ -93,3 +219,24 @@ class LifecycleValidationInfo:
             except Exception:
                 pass                        # fall through to default
         return "vscc", self._default
+
+    def validation_info_for_writes(self, ns: str,
+                                   written_keys: List[str]
+                                   ) -> Tuple[str, bytes]:
+        """Write-aware variant: a `_lifecycle` tx whose writes are ALL
+        one single org's approval keys is that org's local act and
+        validates against /Channel/Application/<org>/Endorsement."""
+        if ns == LIFECYCLE_NS and written_keys:
+            orgs = set()
+            for key in written_keys:
+                got = _APPROVAL_RE.match(key)
+                if got is None:
+                    orgs = None
+                    break
+                orgs.add(got.group(3))
+            if orgs is not None and len(orgs) == 1:
+                org = orgs.pop()
+                return "vscc", m.ApplicationPolicy(
+                    channel_config_policy_reference=
+                    f"/Channel/Application/{org}/Endorsement").encode()
+        return self.validation_info(ns)

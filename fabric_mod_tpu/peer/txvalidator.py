@@ -203,7 +203,7 @@ class TxValidator:
                     return
                 ns = (cca.chaincode_id.name
                       if cca.chaincode_id is not None else "")
-                _plugin, policy_bytes = self._vinfo.validation_info(ns)
+                _plugin, policy_bytes = self._resolve_vinfo(ns, cca)
                 sds = [SignedData(data=prp_bytes + e.endorser,
                                   identity=e.endorser,
                                   signature=e.signature)
@@ -216,6 +216,26 @@ class TxValidator:
         except Exception:
             work.flag = V.INVALID_ENDORSER_TRANSACTION
             return
+
+    def _resolve_vinfo(self, ns: str, cca):
+        """Validation info for one action; `_lifecycle` writes are
+        resolved write-aware when the provider supports it (org-local
+        approval txs validate against that org's Endorsement policy —
+        see peer/lifecycle.py)."""
+        from fabric_mod_tpu.peer.lifecycle import LIFECYCLE_NS
+        write_aware = getattr(self._vinfo, "validation_info_for_writes",
+                              None)
+        if write_aware is not None and ns == LIFECYCLE_NS:
+            try:
+                rwset = m.TxReadWriteSet.decode(cca.results)
+                keys = [w.key
+                        for nsrw in rwset.ns_rwset
+                        if nsrw.namespace == ns
+                        for w in m.KVRWSet.decode(nsrw.rwset).writes]
+                return write_aware(ns, keys)
+            except Exception:
+                pass
+        return self._vinfo.validation_info(ns)
 
     def _stage_key_policies(self, cca, sds, collector, inblock_vp, work):
         """Stage every candidate key-level endorsement policy of this

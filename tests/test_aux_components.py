@@ -75,8 +75,8 @@ def test_ledger_feeds_confighistory(tmp_path):
         pkg = m.CollectionConfigPackage(config=[m.CollectionConfig(
             static_collection_config=m.StaticCollectionConfig(
                 name="col1", block_to_live=2))])
-        net.invoke([b"commit", b"mycc", b"1.0", b"1", b"",
-                    pkg.encode()], chaincode="_lifecycle")
+        net.deploy_chaincode("mycc", "1.0", 1,
+                             collections=pkg.encode())
         client = net.deliver_client()
         t = threading.Thread(
             target=lambda: client.run(idle_timeout_s=4.0), daemon=True)

@@ -225,11 +225,11 @@ def test_pvt_reconciliation_pulls_missing_data(world):
             name="col1",
             member_orgs_policy=from_string(
                 "OR('Org1.peer', 'Org2.peer')")))])
-    net.invoke([b"commit", b"mycc", b"1.0", b"1", b"", pkg.encode()],
-               chaincode="_lifecycle")
+    net.deploy_chaincode("mycc", "1.0", 1, collections=pkg.encode())
     txid = net.invoke([b"putpvt", b"col1", b"acct"],
                       transient={"value": b"reconciled-secret"})
-    blocks = _ordered_blocks(net, 2)
+    # 3 lifecycle txs (2 approvals + commit) + the putpvt
+    blocks = _ordered_blocks(net, 4)
     # only peer0 (Org1) holds the plaintext at commit time
     pvt = m.TxPvtReadWriteSet(ns_pvt_rwset=[m.NsPvtReadWriteSet(
         namespace="mycc",

@@ -1,0 +1,147 @@
+"""Lifecycle org approvals: approve -> checkcommitreadiness -> commit.
+
+(reference test model: core/chaincode/lifecycle suites — scc.go:911
+ApproveChaincodeDefinitionForMyOrg / CheckCommitReadiness /
+CommitChaincodeDefinition, approval bookkeeping at lifecycle.go:770.)
+"""
+import json
+import threading
+import time
+
+import pytest
+
+from fabric_mod_tpu.e2e import Network
+from fabric_mod_tpu.peer.lifecycle import LIFECYCLE_NS, approval_key
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+
+V = m.TxValidationCode
+
+
+@pytest.fixture()
+def net(tmp_path):
+    n = Network(str(tmp_path), batch_timeout="100ms",
+                max_message_count=25)
+    yield n
+    n.close()
+
+
+def _commit_all(net, n_envs, timeout=20.0):
+    return net.pump_committed(n_envs, timeout=timeout)
+
+
+def _approve(net, org, name=b"newcc", version=b"1.0", seq=b"1",
+             policy=b""):
+    net.invoke([b"approve", name, version, seq, policy],
+               endorsing_orgs=[org], chaincode=LIFECYCLE_NS,
+               signer=net.admins[org])
+
+
+def _query(net, args, org="Org1"):
+    """Run a lifecycle QUERY through an endorser, return the payload."""
+    sp, _prop, _txid = protoutil.create_chaincode_proposal(
+        net.channel_id, LIFECYCLE_NS, args, net.client)
+    resp = net.endorsers[org].process_proposal(sp)
+    assert resp.response.status == 200, resp.response.message
+    return resp.response.payload
+
+
+def test_commit_requires_majority_approvals(net):
+    """1-of-3 approvals -> commit rejected at endorsement; 2-of-3 ->
+    accepted (MAJORITY of the channel's application orgs)."""
+    _approve(net, "Org1")
+    assert _commit_all(net, 1) == 1
+
+    # 1-of-3: the commit op must FAIL simulation
+    sp, _p, _t = protoutil.create_chaincode_proposal(
+        net.channel_id, LIFECYCLE_NS,
+        [b"commit", b"newcc", b"1.0", b"1", b""], net.client)
+    resp = net.endorsers["Org1"].process_proposal(sp)
+    assert resp.response.status == 500
+    assert b"approvals" in resp.response.message.encode() or \
+        "approvals" in resp.response.message
+
+    _approve(net, "Org2")
+    assert _commit_all(net, 2) == 2
+
+    # 2-of-3: commit goes through and VALIDATES
+    net.invoke([b"commit", b"newcc", b"1.0", b"1", b""],
+               chaincode=LIFECYCLE_NS)
+    assert _commit_all(net, 3) == 3
+    tip = net.ledger.get_block_by_number(net.ledger.height - 1)
+    assert all(f == V.VALID for f in protoutil.block_txflags(tip))
+    raw = _query(net, [b"query", b"newcc"])
+    d = m.ChaincodeDefinition.decode(raw)
+    assert d.sequence == 1 and d.version == "1.0"
+
+
+def test_checkcommitreadiness_reflects_pending_orgs(net):
+    _approve(net, "Org2")
+    assert _commit_all(net, 1) == 1
+    ready = json.loads(_query(net, [
+        b"checkcommitreadiness", b"newcc", b"1.0", b"1", b""]))
+    assert ready == {"Org1": False, "Org2": True, "Org3": False}
+    _approve(net, "Org3")
+    assert _commit_all(net, 2) == 2
+    ready = json.loads(_query(net, [
+        b"checkcommitreadiness", b"newcc", b"1.0", b"1", b""]))
+    assert ready == {"Org1": False, "Org2": True, "Org3": True}
+
+
+def test_approval_binds_to_exact_parameters(net):
+    """An approval of (1.0, policyA) is NOT an approval of (1.0,
+    policyB): readiness and commit both see a mismatch."""
+    from fabric_mod_tpu.policy import from_string
+    pol_a = m.ApplicationPolicy(signature_policy=from_string(
+        "OR('Org1.peer')")).encode()
+    pol_b = m.ApplicationPolicy(signature_policy=from_string(
+        "OR('Org2.peer')")).encode()
+    _approve(net, "Org1", policy=pol_a)
+    _approve(net, "Org2", policy=pol_a)
+    assert _commit_all(net, 2) == 2
+    ready = json.loads(_query(net, [
+        b"checkcommitreadiness", b"newcc", b"1.0", b"1", pol_b]))
+    assert ready == {"Org1": False, "Org2": False, "Org3": False}
+    # commit with the UNAPPROVED parameters fails simulation
+    sp, _p, _t = protoutil.create_chaincode_proposal(
+        net.channel_id, LIFECYCLE_NS,
+        [b"commit", b"newcc", b"1.0", b"1", pol_b], net.client)
+    resp = net.endorsers["Org1"].process_proposal(sp)
+    assert resp.response.status == 500
+    # and with the approved ones succeeds
+    net.invoke([b"commit", b"newcc", b"1.0", b"1", pol_a],
+               chaincode=LIFECYCLE_NS)
+    assert _commit_all(net, 3) == 3
+    tip = net.ledger.get_block_by_number(net.ledger.height - 1)
+    assert all(f == V.VALID for f in protoutil.block_txflags(tip))
+
+
+def test_approval_recorded_under_creator_org_only(net):
+    """The approval key embeds the CREATOR's MSP id — Org1's admin
+    cannot mint an approval for Org2."""
+    _approve(net, "Org1")
+    assert _commit_all(net, 1) == 1
+    st = net.ledger.state
+    assert st.get_state(LIFECYCLE_NS,
+                        approval_key("newcc", 1, "Org1")) is not None
+    assert st.get_state(LIFECYCLE_NS,
+                        approval_key("newcc", 1, "Org2")) is None
+
+
+def test_queryapproved_returns_my_orgs_digest(net):
+    _approve(net, "Org1")
+    assert _commit_all(net, 1) == 1
+    got = _query(net, [b"queryapproved", b"newcc", b"1"])
+    assert len(got) == 64                    # sha256 hex
+    missing = _query(net, [b"queryapproved", b"newcc", b"2"])
+    assert missing == b""
+
+
+def test_deploy_helper_runs_full_ceremony(net):
+    """Network.deploy_chaincode: approvals by a majority, then commit;
+    every lifecycle tx validates."""
+    total = net.deploy_chaincode("newcc", "1.0", 1)
+    assert total == 3                        # 2 approvals + 1 commit
+    for n in range(1, net.ledger.height):
+        blk = net.ledger.get_block_by_number(n)
+        assert all(f == V.VALID for f in protoutil.block_txflags(blk))

@@ -118,22 +118,20 @@ def test_btl_expiry_purges_private_state(net):
     pkg = m.CollectionConfigPackage(config=[m.CollectionConfig(
         static_collection_config=m.StaticCollectionConfig(
             name="col1", block_to_live=2))])
-    net.invoke([b"commit", b"mycc", b"1.0", b"1", b"", pkg.encode()],
-               chaincode="_lifecycle")
-    assert _commit_all(net, 1) == 1
+    net.deploy_chaincode("mycc", "1.0", 1, collections=pkg.encode())
     net.invoke([b"putpvt", b"col1", b"ephemeral"],
                transient={"value": b"short-lived"})
-    assert _commit_all(net, 2) == 2
+    assert _commit_all(net, 4) == 4
     qe = net.ledger.new_query_executor()
     assert qe.get_private_data("mycc", "col1", "ephemeral") == \
         b"short-lived"
     # advance the chain past the BTL window
     net.invoke([b"put", b"pad1", b"x"])
-    assert _commit_all(net, 3) == 3
-    net.invoke([b"put", b"pad2", b"x"])
-    assert _commit_all(net, 4) == 4
-    net.invoke([b"put", b"pad3", b"x"])
     assert _commit_all(net, 5) == 5
+    net.invoke([b"put", b"pad2", b"x"])
+    assert _commit_all(net, 6) == 6
+    net.invoke([b"put", b"pad3", b"x"])
+    assert _commit_all(net, 7) == 7
     qe = net.ledger.new_query_executor()
     assert qe.get_private_data("mycc", "col1", "ephemeral") is None
 
@@ -144,21 +142,19 @@ def test_btl_rewrite_gets_its_own_expiry_window(net):
     pkg = m.CollectionConfigPackage(config=[m.CollectionConfig(
         static_collection_config=m.StaticCollectionConfig(
             name="col1", block_to_live=2))])
-    net.invoke([b"commit", b"mycc", b"1.0", b"1", b"", pkg.encode()],
-               chaincode="_lifecycle")
-    assert _commit_all(net, 1) == 1            # block 1
+    net.deploy_chaincode("mycc", "1.0", 1, collections=pkg.encode())
     net.invoke([b"putpvt", b"col1", b"k"], transient={"value": b"v1"})
-    assert _commit_all(net, 2) == 2            # block 2: expiry @ 5
+    assert _commit_all(net, 4) == 4            # block B: expiry @ B+3
     net.invoke([b"putpvt", b"col1", b"k"], transient={"value": b"v2"})
-    assert _commit_all(net, 3) == 3            # block 3: expiry @ 6
+    assert _commit_all(net, 5) == 5            # block B+1: expiry @ B+4
     net.invoke([b"put", b"pad1", b"x"])
-    assert _commit_all(net, 4) == 4            # block 4
+    assert _commit_all(net, 6) == 6            # block B+2
     net.invoke([b"put", b"pad2", b"x"])
-    assert _commit_all(net, 5) == 5            # block 5: first expiry
+    assert _commit_all(net, 7) == 7            # block B+3: first expiry
     qe = net.ledger.new_query_executor()
     assert qe.get_private_data("mycc", "col1", "k") == b"v2"
     net.invoke([b"put", b"pad3", b"x"])
-    assert _commit_all(net, 6) == 6            # block 6: second expiry
+    assert _commit_all(net, 8) == 8            # block B+4: second expiry
     qe = net.ledger.new_query_executor()
     assert qe.get_private_data("mycc", "col1", "k") is None
 

@@ -151,15 +151,14 @@ def test_lifecycle_definition_changes_cc_policy(net):
     net.invoke([b"put", b"a", b"1"], endorsing_orgs=["Org1", "Org2"])
     assert _commit_all(net, 1) == 1
 
-    # commit a definition pinning mycc to Org1 only
-    net.invoke([b"commit", b"mycc", b"2.0", b"1", _org_policy("Org1")],
-               endorsing_orgs=["Org1", "Org2"], chaincode="_lifecycle")
-    assert _commit_all(net, 2) == 2
+    # commit a definition pinning mycc to Org1 only (the full
+    # approve->commit ceremony: 2 approvals + 1 commit = 3 more txs)
+    net.deploy_chaincode("mycc", "2.0", 1, policy=_org_policy("Org1"))
 
     # now Org2-endorsed writes fail, Org1-endorsed pass
     net.invoke([b"put", b"b", b"2"], endorsing_orgs=["Org2"])
     net.invoke([b"put", b"c", b"3"], endorsing_orgs=["Org1"])
-    assert _commit_all(net, 4) == 4
+    assert _commit_all(net, 6) == 6
     flags = _all_flags(net)
     assert flags.count(V.ENDORSEMENT_POLICY_FAILURE) == 1
     qe = net.ledger.new_query_executor()
