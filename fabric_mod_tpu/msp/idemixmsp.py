@@ -26,7 +26,7 @@ from typing import Dict, Optional, Sequence, Tuple
 from fabric_mod_tpu.idemix import credential as idmx
 from fabric_mod_tpu.protos import messages as m
 
-ATTR_OU, ATTR_ROLE = 0, 1
+ATTR_OU, ATTR_ROLE, ATTR_EID, ATTR_RH = 0, 1, 2, 3
 ROLE_MEMBER, ROLE_ADMIN = 1, 2
 
 
@@ -67,8 +67,17 @@ class IdemixUser:
         self.ou = ou
         self.role = role
 
-    def _disclosed(self) -> Dict[int, int]:
-        return {ATTR_OU: _attr_int(self.ou), ATTR_ROLE: self.role}
+    @property
+    def revocation_handle(self) -> int:
+        return self._cred.attrs[ATTR_RH]
+
+    def _disclosed(self, disclose_rh: bool = False) -> Dict[int, int]:
+        out = {ATTR_OU: _attr_int(self.ou), ATTR_ROLE: self.role}
+        if disclose_rh:
+            # revocation-enforcing verifiers need the handle bound
+            # into the proof (see idemix/revocation.py's privacy note)
+            out[ATTR_RH] = self.revocation_handle
+        return out
 
 
 class IdemixSigningIdentity:
@@ -78,10 +87,12 @@ class IdemixSigningIdentity:
     disclosed attributes; verifiers check it against the issuer public
     key carried by the MSP."""
 
-    def __init__(self, user: IdemixUser, issuer_key: idmx.IssuerKey):
+    def __init__(self, user: IdemixUser, issuer_key: idmx.IssuerKey,
+                 disclose_rh: bool = False):
         self.mspid = user.mspid
         self._user = user
         self._ik = issuer_key
+        self._disclose_rh = disclose_rh
 
     def serialize(self) -> bytes:
         payload = json.dumps({
@@ -91,9 +102,13 @@ class IdemixSigningIdentity:
                                     id_bytes=payload).encode()
 
     def sign_message(self, msg: bytes) -> bytes:
+        disclosed = self._user._disclosed(self._disclose_rh)
         sig = idmx.sign(self._ik, self._user._cred, self._user._sk,
-                        msg, self._user._disclosed())
-        return json.dumps(_sig_to_dict(sig), sort_keys=True).encode()
+                        msg, disclosed)
+        d = _sig_to_dict(sig)
+        if self._disclose_rh:
+            d["rh"] = str(self._user.revocation_handle)
+        return json.dumps(d, sort_keys=True).encode()
 
 
 def _sig_to_dict(sig: idmx.Signature) -> dict:
@@ -135,11 +150,12 @@ class IdemixIdentity:
     """Verifier-side view of a deserialized idemix identity."""
 
     def __init__(self, mspid: str, ou: str, role: int,
-                 issuer_key: idmx.IssuerKey):
+                 issuer_key: idmx.IssuerKey, cri_fn=None):
         self.mspid = mspid
         self.ou = ou
         self.role = role
         self._ik = issuer_key
+        self._cri_fn = cri_fn              # () -> CRI | None
 
     def serialize(self) -> bytes:
         payload = json.dumps({"ou": self.ou, "role": self.role},
@@ -149,11 +165,31 @@ class IdemixIdentity:
 
     def verify(self, msg: bytes, sig_bytes: bytes) -> bool:
         try:
-            sig = _sig_from_dict(json.loads(sig_bytes))
+            d = json.loads(sig_bytes)
+            sig = _sig_from_dict(d)
         except Exception:
             return False
         disclosed = {ATTR_OU: _attr_int(self.ou),
                      ATTR_ROLE: self.role}
+        cri = self._cri_fn() if self._cri_fn is not None else None
+        if cri is not None:
+            # revocation enforced: the presentation must disclose its
+            # handle (binding it into the credential via the ordinary
+            # disclosed-attribute relation) and the handle must not be
+            # in the CRI (reference: signature.go:243 Ver's
+            # non-revocation check).  The field is attacker
+            # controlled: any malformed/out-of-range value is a
+            # verification failure, never an exception (one crafted
+            # signature must not abort block validation).
+            try:
+                rh = int(d["rh"])
+                if not 0 <= rh < (1 << 256):
+                    return False
+            except (KeyError, ValueError, TypeError):
+                return False
+            if cri.is_revoked(rh):
+                return False
+            disclosed[ATTR_RH] = rh
         return idmx.verify(self._ik, sig, msg, disclosed)
 
     def verify_item(self, msg: bytes, sig: bytes):
@@ -166,11 +202,29 @@ class IdemixIdentity:
 class IdemixMsp:
     """(reference: msp/idemixmsp.go)"""
 
-    def __init__(self, mspid: str, issuer_key: idmx.IssuerKey):
+    def __init__(self, mspid: str, issuer_key: idmx.IssuerKey,
+                 revocation_pk_pem: Optional[bytes] = None):
         self.mspid = mspid
         self._ik = issuer_key
+        self._revocation_pk = revocation_pk_pem
+        self._cri = None
         if not issuer_key.check_pok():
             raise IdemixError("issuer key proof of knowledge fails")
+
+    def set_cri(self, cri, expected_epoch: Optional[int] = None) -> None:
+        """Adopt a CRI after verifying the RA signature + epoch pin
+        (reference: the CRI refresh of idemixmsp Setup/Validate).
+        Requires the MSP to have been configured with the RA public
+        key; a CRI that fails verification is refused."""
+        from fabric_mod_tpu.idemix.revocation import verify_cri
+        if self._revocation_pk is None:
+            raise IdemixError("this MSP has no revocation authority "
+                              "public key configured")
+        if not verify_cri(cri, self._revocation_pk, expected_epoch):
+            raise IdemixError("CRI verification failed")
+        if self._cri is not None and cri.epoch < self._cri.epoch:
+            raise IdemixError("CRI epoch regression")
+        self._cri = cri
 
     def deserialize_identity(self, serialized: bytes) -> IdemixIdentity:
         sid = m.SerializedIdentity.decode(serialized)
@@ -182,7 +236,8 @@ class IdemixMsp:
             ou, role = str(d["ou"]), int(d["role"])
         except Exception as e:
             raise IdemixError(f"bad idemix identity: {e}") from e
-        return IdemixIdentity(self.mspid, ou, role, self._ik)
+        return IdemixIdentity(self.mspid, ou, role, self._ik,
+                              cri_fn=lambda: self._cri)
 
     def validate(self, ident: IdemixIdentity) -> None:
         if ident.mspid != self.mspid:

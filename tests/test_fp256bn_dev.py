@@ -107,9 +107,15 @@ def points():
     }
 
 
-def test_miller_loop_matches_host(points):
+def test_miller_loop_and_full_pairing_match_host(points):
     """The batched scan Miller loop (sparse lines, shared-G2 schedule)
-    equals the host's generic Fp12 Miller loop."""
+    equals the host's generic Fp12 Miller loop — and composing the
+    device FINAL EXPONENTIATION on the Miller output reproduces the
+    host's full pairing.  The final exp runs EAGERLY: jitting it costs
+    >9 min of XLA compile on CPU while eager dispatch finishes in ~3,
+    so the full e(P, W) equation is exercised on every suite run with
+    no env gate (the jitted single-program variant stays behind
+    FMT_SLOW_TESTS for on-chip sessions)."""
     import jax
     sched = dev.line_schedule(points["W"])
     xs, ys = dev._g1_batch_to_mont_np([points["P1"], points["P2"]])
@@ -118,6 +124,11 @@ def test_miller_loop_matches_host(points):
                                                      points["W"])
     assert dev.f12_to_host(f, 1) == host.miller_loop(points["P2"],
                                                      points["W"])
+    out = dev.final_exp_batch(f)           # eager by design, see above
+    assert dev.f12_to_host(out, 0) == host.pairing(points["P1"],
+                                                   points["W"])
+    assert dev.f12_to_host(out, 1) == host.pairing(points["P2"],
+                                                   points["W"])
 
 
 def test_line_schedule_is_cached(points):

@@ -145,3 +145,59 @@ def test_deploy_helper_runs_full_ceremony(net):
     for n in range(1, net.ledger.height):
         blk = net.ledger.get_block_by_number(n)
         assert all(f == V.VALID for f in protoutil.block_txflags(blk))
+
+
+def test_same_block_definition_does_not_affect_sibling_invokes(net):
+    """A definition commit and an invoke of the same chaincode in ONE
+    block: the invoke validates under the PRE-block (committed)
+    definition — lifecycle changes take effect for subsequent blocks
+    only, unlike key-level VALIDATION_PARAMETERs which resolve
+    in-block (reference: the lifecycle cache reads committed state;
+    validator_keylevel.go has the in-block ordering rules)."""
+    from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder
+    from fabric_mod_tpu.policy import from_string
+    from fabric_mod_tpu.protos import protoutil as pu
+
+    # ceremony for a definition pinning mycc to Org3 only
+    pol = m.ApplicationPolicy(signature_policy=from_string(
+        "OR('Org3.peer')")).encode()
+    _approve(net, "Org1", name=b"mycc", version=b"9.9", policy=pol)
+    _approve(net, "Org2", name=b"mycc", version=b"9.9", policy=pol)
+    assert _commit_all(net, 2) == 2
+
+    # hand-build ONE block holding [definition-commit, mycc invoke
+    # endorsed by Org1+Org2 (old MAJORITY rule, violates new
+    # Org3-only rule)]
+    sp, prop, _ = pu.create_chaincode_proposal(
+        net.channel_id, LIFECYCLE_NS,
+        [b"commit", b"mycc", b"9.9", b"1", pol], net.client)
+    responses = [net.endorsers[o].process_proposal(sp)
+                 for o in ("Org1", "Org2")]
+    assert all(r.response.status == 200 for r in responses)
+    def_env = pu.create_tx_from_responses(prop, responses, net.client)
+
+    b = RWSetBuilder()
+    b.add_write("mycc", "sameblock", b"v")
+    inv_env = pu.create_signed_tx(
+        net.channel_id, "mycc", b.build().encode(), net.client,
+        [net.peer_signers["Org1"], net.peer_signers["Org2"]])
+
+    blk = pu.new_block(
+        net.ledger.height,
+        pu.block_header_hash(net.ledger.get_block_by_number(
+            net.ledger.height - 1).header), [def_env, inv_env])
+    flags = net.channel.validator().validate(blk)
+    # both VALID: the invoke is judged under the OLD policy
+    assert flags == [V.VALID, V.VALID], flags
+    net.ledger.commit_block(blk, flags)
+
+    # NEXT block: the new Org3-only policy is now in force
+    inv2 = pu.create_signed_tx(
+        net.channel_id, "mycc", b.build().encode(), net.client,
+        [net.peer_signers["Org1"], net.peer_signers["Org2"]])
+    blk2 = pu.new_block(
+        net.ledger.height,
+        pu.block_header_hash(net.ledger.get_block_by_number(
+            net.ledger.height - 1).header), [inv2])
+    flags2 = net.channel.validator().validate(blk2)
+    assert flags2 == [V.ENDORSEMENT_POLICY_FAILURE], flags2
