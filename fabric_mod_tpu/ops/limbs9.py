@@ -199,13 +199,28 @@ def carry_mod_r(x: jnp.ndarray) -> jnp.ndarray:
 # Schoolbook + Montgomery (the MXU path)
 # ---------------------------------------------------------------------------
 
+# Trace-time constant source override: Pallas kernels may not capture
+# array constants, so while a kernel body is being traced this hook
+# maps the module's numpy constant singletons (by IDENTITY) to values
+# read from kernel input refs.  None outside kernel tracing.
+CONST_LOOKUP = None
+
+
+def const_jnp(arr: np.ndarray) -> jnp.ndarray:
+    if CONST_LOOKUP is not None:
+        got = CONST_LOOKUP(arr)
+        if got is not None:
+            return got
+    return jnp.asarray(arr)
+
+
 def const_dot(mat: np.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     """(rows, cols) constant  @  (cols, ...batch) -> (rows, ...batch).
 
     ALWAYS use this (never a bare jnp.matmul/tensordot) for any product
     involving limb values: it pins PRECISION so the TPU does not round
     f32 operands to bf16 (integers > 256 are not bf16-exact)."""
-    return jnp.tensordot(jnp.asarray(mat), x, axes=(1, 0),
+    return jnp.tensordot(const_jnp(mat), x, axes=(1, 0),
                          precision=PRECISION)
 
 
@@ -237,10 +252,24 @@ def sb_mul_cols(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return const_dot(_COLSUM, outer.reshape((K * K,) + outer.shape[2:]))
 
 
+# When True, the sequential low-carry unrolls to straight-line code
+# with STATIC row indices — required inside Pallas kernels (Mosaic's
+# dynamic sublane indexing is the risk) and a compile-time/runtime
+# trade elsewhere.  Trace-time flag: set it around tracing, not calls.
+UNROLL_LOW_CARRY = False
+
+
 def _exact_low_carry(s: jnp.ndarray) -> jnp.ndarray:
     """Exact carry out of the low K limbs of s (value ≡ 0 mod R).
 
-    Sequential by nature; fori_loop so the body compiles once."""
+    Sequential by nature; fori_loop so the body compiles once (or
+    unrolled under UNROLL_LOW_CARRY, see above)."""
+    if UNROLL_LOW_CARRY:
+        c = jnp.zeros(s.shape[1:], _F)
+        for i in range(K):
+            c = jnp.floor((s[i] + c) * (1.0 / BASE))
+        return c
+
     def body(i, c):
         row = jax.lax.dynamic_index_in_dim(s, i, axis=0, keepdims=False)
         return jnp.floor((row + c) * (1.0 / BASE))
@@ -301,7 +330,7 @@ def const_like(c: np.ndarray, a: jnp.ndarray) -> jnp.ndarray:
     With the limb axis FIRST, numpy-style trailing-axis broadcasting
     would mis-align a bare (K,) against (K, batch...) — every constant
     must be lifted explicitly."""
-    return jnp.asarray(c).reshape((K,) + (1,) * (a.ndim - 1))
+    return const_jnp(c).reshape((K,) + (1,) * (a.ndim - 1))
 
 
 def to_mont(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:

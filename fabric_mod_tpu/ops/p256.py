@@ -232,10 +232,60 @@ def on_curve(xm: jnp.ndarray, ym: jnp.ndarray) -> jnp.ndarray:
 
 # --- The jitted verify core ------------------------------------------------
 
-@jax.jit
-def verify_core(e: jnp.ndarray, r: jnp.ndarray, s: jnp.ndarray,
-                qx: jnp.ndarray, qy: jnp.ndarray,
-                rn_lt_p: jnp.ndarray) -> jnp.ndarray:
+def shamir_ladder(u1_w: jnp.ndarray, u2_w: jnp.ndarray,
+                  qx_m: jnp.ndarray, qy_m: jnp.ndarray):
+    """The windowed Shamir ladder: u1*G + u2*Q from MSB-first window
+    values (N_WINDOWS, batch) and the Montgomery-domain affine key.
+    Returns the projective (X, Y, Z).  This is the dominant cost of a
+    verify; ops/p256_pallas.py provides a VMEM-fused drop-in."""
+    fp, _fn, b_m_np, _, _ = _consts()
+    batch = qx_m.shape[1:]
+    b_m = const_like(b_m_np, qx_m)
+
+    one_m = infinity(batch)[1]
+    q1 = (qx_m, qy_m, one_m)
+    qtab = [infinity(batch), q1]
+    for i in range(2, TABLE):
+        if i % 2 == 0:
+            qtab.append(point_double(qtab[i // 2], fp, b_m))
+        else:
+            qtab.append(point_add(qtab[i - 1], q1, fp, b_m))
+    q_table = tuple(
+        jnp.stack([pt[c] for pt in qtab], axis=0)    # (TABLE, K, batch)
+        for c in range(3))
+    g_tab_np = _g_table()                            # (3, TABLE, K)
+
+    # MSB -> LSB: per step WINDOW doublings, one add from each table
+    # (complete addition absorbs the zero-window infinity entries
+    # branch-free).
+    sel_seq = jnp.stack([u1_w, u2_w], axis=1)        # (NW, 2, batch)
+
+    def step(acc, w2):
+        # WINDOW doublings as a fori_loop: the traced scan body holds
+        # ONE doubling instead of WINDOW unrolled copies — measurably
+        # faster XLA compiles with identical math.
+        acc = jax.lax.fori_loop(
+            0, WINDOW, lambda _i, a: point_double(a, fp, b_m), acc)
+        # Q-table select: one-hot reduce over the per-lane tables (VPU).
+        oh_q = jax.nn.one_hot(w2[1], TABLE, dtype=jnp.float32, axis=0)
+        acc = point_add(acc, tuple(
+            jnp.sum(oh_q[:, None] * q_table[c], axis=0)
+            for c in range(3)), fp, b_m)
+        # G-table select: constant table -> one-hot matmul (MXU).
+        # const_dot, NOT a bare tensordot: table limbs reach 511 and
+        # would be rounded by the TPU's default bf16 matmul precision.
+        oh_g = jax.nn.one_hot(w2[0], TABLE, dtype=jnp.float32, axis=0)
+        acc = point_add(acc, tuple(
+            const_dot(g_tab_np[c].T, oh_g)
+            for c in range(3)), fp, b_m)
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, infinity(batch), sel_seq)
+    return acc
+
+
+def _verify_core_impl(e, r, s, qx, qy, rn_lt_p,
+                      ladder=shamir_ladder) -> jnp.ndarray:
     """Batched ECDSA-P256 verify on raw limb arrays.
 
     Args:
@@ -247,9 +297,8 @@ def verify_core(e: jnp.ndarray, r: jnp.ndarray, s: jnp.ndarray,
     Returns:
       (batch,) bool — signature valid AND key on curve.
     """
-    fp, fn, b_m_np, _, _ = _consts()
+    fp, fn, _b_m_np, _, _ = _consts()
     batch = e.shape[1:]
-    b_m = const_like(b_m_np, e)
 
     # Key checks: on curve, not the identity encoding (0, 0).
     qx_m = to_mont(qx, fp)
@@ -276,49 +325,7 @@ def verify_core(e: jnp.ndarray, r: jnp.ndarray, s: jnp.ndarray,
     u1_w = windows_msb_first(u1)
     u2_w = windows_msb_first(u2)
 
-    # Per-lane table [inf, Q, 2Q, ..., 15Q] (projective, Montgomery
-    # domain), built on device with 7 doublings + 7 additions; the
-    # fixed-base counterpart [inf, G, ..., 15G] is a host-precomputed
-    # shared constant (_g_table).
-    one_m = infinity(batch)[1]
-    q1 = (qx_m, qy_m, one_m)
-    qtab = [infinity(batch), q1]
-    for i in range(2, TABLE):
-        if i % 2 == 0:
-            qtab.append(point_double(qtab[i // 2], fp, b_m))
-        else:
-            qtab.append(point_add(qtab[i - 1], q1, fp, b_m))
-    q_table = tuple(
-        jnp.stack([pt[c] for pt in qtab], axis=0)    # (TABLE, K, batch)
-        for c in range(3))
-    g_tab_np = _g_table()                            # (3, TABLE, K)
-
-    # Windowed Shamir ladder, MSB -> LSB: per step WINDOW doublings,
-    # one add from each table (complete addition absorbs the zero-window
-    # infinity entries branch-free).
-    sel_seq = jnp.stack([u1_w, u2_w], axis=1)        # (NW, 2, batch)
-
-    def step(acc, w2):
-        # WINDOW doublings as a fori_loop: the traced scan body holds
-        # ONE doubling instead of WINDOW unrolled copies — measurably
-        # faster XLA compiles with identical math.
-        acc = jax.lax.fori_loop(
-            0, WINDOW, lambda _i, a: point_double(a, fp, b_m), acc)
-        # Q-table select: one-hot reduce over the per-lane tables (VPU).
-        oh_q = jax.nn.one_hot(w2[1], TABLE, dtype=jnp.float32, axis=0)
-        acc = point_add(acc, tuple(
-            jnp.sum(oh_q[:, None] * q_table[c], axis=0)
-            for c in range(3)), fp, b_m)
-        # G-table select: constant table -> one-hot matmul (MXU).
-        # const_dot, NOT a bare tensordot: table limbs reach 511 and
-        # would be rounded by the TPU's default bf16 matmul precision.
-        oh_g = jax.nn.one_hot(w2[0], TABLE, dtype=jnp.float32, axis=0)
-        acc = point_add(acc, tuple(
-            const_dot(g_tab_np[c].T, oh_g)
-            for c in range(3)), fp, b_m)
-        return acc, None
-
-    acc, _ = jax.lax.scan(step, infinity(batch), sel_seq)
+    acc = ladder(u1_w, u2_w, qx_m, qy_m)
     X, Z = acc[0], acc[2]
 
     # Accept iff Z != 0 and X == r'*Z for r' in {r, r+n} (r' < p).
@@ -329,6 +336,9 @@ def verify_core(e: jnp.ndarray, r: jnp.ndarray, s: jnp.ndarray,
     rn_m = to_mont(rn, fp)
     ok_rn = eq_zero(sub(X, mont_mul(rn_m, Z, fp)), fp) & rn_lt_p
     return key_ok & not_inf & (ok_r | ok_rn)
+
+
+verify_core = jax.jit(_verify_core_impl)
 
 
 # --- Host wrapper ----------------------------------------------------------
@@ -414,5 +424,32 @@ def batch_verify(digests: np.ndarray, r_bytes: np.ndarray,
             arr = jax.device_put(arr, s)
         return arr
 
-    ok = verify_core(*(_dev(a, s) for a, s in zip(core_args, shardings)))
+    core = verify_core
+    if _use_pallas() and mesh is None:
+        # mesh path stays on the XLA core: GSPMD partitions that
+        # program across chips, which it cannot do for the
+        # single-device pallas_call
+        batch = digests.shape[0]
+        tile = next(t for t in (128, 64, 32, 16, 8, 4, 2, 1)
+                    if batch % t == 0)
+        core = _pallas_core(tile)
+    ok = core(*(_dev(a, s) for a, s in zip(core_args, shardings)))
     return np.asarray(ok) & range_ok
+
+
+def _use_pallas() -> bool:
+    """FABRIC_MOD_TPU_PALLAS=1 swaps the VMEM-fused Pallas ladder into
+    the verify pipeline (ops/p256_pallas.py) — dark-launched until
+    on-chip measurement confirms it over the XLA ladder.  No-op on the
+    CPU backend (compiled pallas_call is TPU-only; the interpreter is
+    for tests)."""
+    import os
+    if os.environ.get("FABRIC_MOD_TPU_PALLAS", "") != "1":
+        return False
+    return jax.default_backend() != "cpu"
+
+
+@functools.lru_cache(maxsize=None)
+def _pallas_core(tile: int):
+    from fabric_mod_tpu.ops.p256_pallas import verify_core_pallas
+    return jax.jit(functools.partial(verify_core_pallas, tile=tile))

@@ -1,0 +1,210 @@
+"""Pallas-fused Shamir ladder: the whole scalar-mult loop in VMEM.
+
+The XLA version of the ladder (ops/p256.shamir_ladder) materializes
+every intermediate limb array to HBM between fusions — measured to be
+the throughput ceiling at the XLA level (ROUND3_NOTES "kernel perf
+findings": long element-wise chains run at ~0.07 Tops/s because they
+are HBM-materialization-bound).  This kernel keeps the accumulator,
+the per-lane Q window table, and every Montgomery intermediate in
+VMEM for the full 64-window ladder.
+
+Structure (designed around the Mosaic failure modes catalogued in
+round 3 — no giant concats, no scratch-slice accumulation, no
+unrolled vreg lists, no dynamic sublane indexing):
+
+* grid = (batch_tiles, N_WINDOWS); TPU grids execute sequentially
+  with the LAST axis minor, so for one batch tile the 64 window steps
+  run in order sharing VMEM scratch (the standard accumulator
+  pattern).
+* window selections arrive pre-tiled via BlockSpec index maps — the
+  kernel never indexes by a loop variable;
+* the Q window table (16 points, built once per tile at window 0)
+  lives in three (TABLE*K, T) f32 scratch buffers; selects are
+  one-hot multiply-reduces;
+* the G table is a host constant folded in with a precision-pinned
+  dot;
+* all field math is ops/limbs9 — inside the kernel the sequential
+  low-carry unrolls to static row indices (limbs9.UNROLL_LOW_CARRY).
+
+The kernel is numerically IDENTICAL to the XLA ladder (same formulas,
+same order), differentially tested in interpret mode; flip it on in
+production with FABRIC_MOD_TPU_PALLAS=1 (bccsp/tpu.py) once on-chip
+measurement confirms the win.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fabric_mod_tpu.ops import limbs9 as limbs
+from fabric_mod_tpu.ops import p256
+from fabric_mod_tpu.ops.limbs9 import K, PRECISION
+from fabric_mod_tpu.ops.p256 import (
+    N_WINDOWS, TABLE, _consts, _g_table, point_add, point_double)
+
+_F = jnp.float32
+
+
+def _one_hot(sel: jnp.ndarray, t: int) -> jnp.ndarray:
+    """(T,) int32 -> (TABLE, T) f32 one-hot via 2D iota (Mosaic needs
+    >= 2D iotas; jax.nn.one_hot can emit 1D)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (TABLE, t), 0)
+    return (rows == sel[None, :]).astype(_F)
+
+
+def _ladder_kernel(sel1_ref, sel2_ref, qx_ref, qy_ref,
+                   colsum_ref, colsum_sqr_ref, npmat_ref, pmat_ref,
+                   onemont_ref, bm_ref, gtab_ref,
+                   xo_ref, yo_ref, zo_ref,
+                   qtx_ref, qty_ref, qtz_ref,
+                   accx_ref, accy_ref, accz_ref):
+    import jax.experimental.pallas as pl
+
+    fp, _fn, _b_m_np, _gx, _gy = _consts()
+    t = qx_ref.shape[1]
+    nw = pl.program_id(1)
+
+    # Pallas kernels may not capture array constants; the limb layer's
+    # fold matrices arrive as inputs and are routed into limbs9's
+    # mont ops via the identity-keyed CONST_LOOKUP hook (trace-time).
+    const_map = {
+        id(limbs._COLSUM): colsum_ref[...],
+        id(limbs._COLSUM_SQR): colsum_sqr_ref[...],
+        id(fp.np_mat): npmat_ref[...],
+        id(fp.p_mat): pmat_ref[...],
+    }
+    old_hook = limbs.CONST_LOOKUP
+    limbs.CONST_LOOKUP = lambda arr: const_map.get(id(arr))
+    try:
+        b_m = bm_ref[...]                            # (K, 1)
+        one_m = jnp.broadcast_to(onemont_ref[...], (K, t))
+        zero = jnp.zeros((K, t), _F)
+
+        @pl.when(nw == 0)
+        def _init():
+            # per-lane window table [inf, Q, 2Q, ..., 15Q]
+            q1 = (qx_ref[...], qy_ref[...], one_m)
+            qtab = [(zero, one_m, zero), q1]
+            for i in range(2, TABLE):
+                if i % 2 == 0:
+                    qtab.append(point_double(qtab[i // 2], fp, b_m))
+                else:
+                    qtab.append(point_add(qtab[i - 1], q1, fp, b_m))
+            qtx_ref[...] = jnp.concatenate([pt[0] for pt in qtab],
+                                           axis=0)
+            qty_ref[...] = jnp.concatenate([pt[1] for pt in qtab],
+                                           axis=0)
+            qtz_ref[...] = jnp.concatenate([pt[2] for pt in qtab],
+                                           axis=0)
+            accx_ref[...] = zero
+            accy_ref[...] = one_m
+            accz_ref[...] = zero
+
+        acc = (accx_ref[...], accy_ref[...], accz_ref[...])
+        # WINDOW doublings (unrolled: 4 copies trace once per kernel,
+        # not per window — the window loop is the grid)
+        for _ in range(p256.WINDOW):
+            acc = point_double(acc, fp, b_m)
+        # Q-table select: one-hot reduce over the VMEM-resident table
+        oh_q = _one_hot(sel2_ref[0], t)[:, None]     # (TABLE, 1, T)
+        qsel = tuple(
+            jnp.sum(oh_q * ref[...].reshape(TABLE, K, t), axis=0)
+            for ref in (qtx_ref, qty_ref, qtz_ref))
+        acc = point_add(acc, qsel, fp, b_m)
+        # G-table select (precision-pinned: limbs reach 511)
+        oh_g = _one_hot(sel1_ref[0], t)
+        gt = gtab_ref[...]                           # (3*K, TABLE)
+        gsel = tuple(
+            jax.lax.dot_general(gt[c * K:(c + 1) * K], oh_g,
+                                (((1,), (0,)), ((), ())),
+                                precision=PRECISION)
+            for c in range(3))
+        acc = point_add(acc, gsel, fp, b_m)
+
+        accx_ref[...], accy_ref[...], accz_ref[...] = acc
+
+        @pl.when(nw == N_WINDOWS - 1)
+        def _finish():
+            xo_ref[...] = accx_ref[...]
+            yo_ref[...] = accy_ref[...]
+            zo_ref[...] = accz_ref[...]
+    finally:
+        limbs.CONST_LOOKUP = old_hook
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _ladder_call(u1_w, u2_w, qx_m, qy_m, tile: int = 128,
+                 interpret: bool = False):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch = qx_m.shape[1]
+    if batch % tile != 0:
+        # explicit raise, not assert: under python -O a stripped
+        # assert would silently drop the remainder lanes and return
+        # uninitialized output rows for them
+        raise ValueError(f"batch {batch} not divisible by tile {tile}")
+    grid = (batch // tile, N_WINDOWS)
+    sel_spec = pl.BlockSpec((1, tile), lambda i, nw: (nw, i))
+    limb_spec = pl.BlockSpec((K, tile), lambda i, nw: (0, i))
+
+    def full(shape):
+        return pl.BlockSpec(shape, lambda i, nw: (0, 0))
+
+    fp, _fn, b_m_np, _gx, _gy = _consts()
+    g_tab = _g_table()                               # (3, TABLE, K)
+    g_flat = np.concatenate([g_tab[c].T for c in range(3)],
+                            axis=0).astype(np.float32)  # (3K, TABLE)
+    consts = (
+        limbs._COLSUM, limbs._COLSUM_SQR,
+        fp.np_mat, fp.p_mat,
+        fp.one_mont.reshape(K, 1).astype(np.float32),
+        np.asarray(b_m_np, np.float32).reshape(K, 1),
+        g_flat,
+    )
+
+    old = limbs.UNROLL_LOW_CARRY
+    limbs.UNROLL_LOW_CARRY = True          # static indices in-kernel
+    try:
+        out_shape = [jax.ShapeDtypeStruct((K, batch), _F)] * 3
+        x, y, z = pl.pallas_call(
+            _ladder_kernel,
+            grid=grid,
+            in_specs=[sel_spec, sel_spec, limb_spec, limb_spec]
+                     + [full(c.shape) for c in consts],
+            out_specs=[limb_spec] * 3,
+            out_shape=out_shape,
+            scratch_shapes=[
+                pltpu.VMEM((TABLE * K, tile), _F),   # q table x
+                pltpu.VMEM((TABLE * K, tile), _F),   # q table y
+                pltpu.VMEM((TABLE * K, tile), _F),   # q table z
+                pltpu.VMEM((K, tile), _F),           # acc x
+                pltpu.VMEM((K, tile), _F),           # acc y
+                pltpu.VMEM((K, tile), _F),           # acc z
+            ],
+            interpret=interpret,
+        )(u1_w.astype(jnp.int32), u2_w.astype(jnp.int32), qx_m, qy_m,
+          *(jnp.asarray(c) for c in consts))
+    finally:
+        limbs.UNROLL_LOW_CARRY = old
+    return x, y, z
+
+
+def pallas_ladder(u1_w, u2_w, qx_m, qy_m, tile: int = 128,
+                  interpret: bool = False):
+    """Drop-in for p256.shamir_ladder (same signature + semantics)."""
+    return _ladder_call(u1_w, u2_w, qx_m, qy_m, tile=tile,
+                        interpret=interpret)
+
+
+def verify_core_pallas(e, r, s, qx, qy, rn_lt_p, tile: int = 128,
+                       interpret: bool = False):
+    """p256._verify_core_impl with the VMEM-fused ladder (jit this
+    per deployment; bccsp/tpu.py wires it under FABRIC_MOD_TPU_PALLAS)."""
+    ladder = functools.partial(pallas_ladder, tile=tile,
+                               interpret=interpret)
+    return p256._verify_core_impl(e, r, s, qx, qy, rn_lt_p,
+                                  ladder=ladder)
