@@ -33,3 +33,47 @@ def dump_threads(file=None) -> str:
 def install_signal_dump(sig=signal.SIGUSR1) -> None:
     """SIGUSR1 -> thread stacks on stderr (reference: signals.go)."""
     faulthandler.register(sig, file=sys.stderr, all_threads=True)
+
+
+def sample_profile(seconds: float = 5.0, hz: float = 100.0) -> str:
+    """Sampling CPU profile: collapsed-stack text, one line per unique
+    stack with its sample count — the flamegraph/pprof interchange
+    format (reference: the /debug/pprof/profile endpoint the
+    operations server mounts, core/middleware + go pprof).
+
+    Pure-stdlib wall-sampler over sys._current_frames(); it observes
+    every thread, costs one stack walk per thread per tick, and needs
+    no native agent.  Blocking — callers run it from a request
+    handler thread."""
+    import time
+    from collections import Counter
+
+    interval = 1.0 / hz
+    counts: Counter = Counter()
+    deadline = time.monotonic() + seconds
+    me = threading.get_ident()
+    n_samples = 0
+    while time.monotonic() < deadline:
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for ident, frame in frames.items():
+            if ident == me:
+                continue                   # not the profiler itself
+            stack = []
+            while frame is not None:
+                code = frame.f_code
+                stack.append(f"{code.co_name} "
+                             f"({code.co_filename.rsplit('/', 1)[-1]}"
+                             f":{frame.f_lineno})")
+                frame = frame.f_back
+            counts[(names.get(ident, str(ident)),
+                    ";".join(reversed(stack)))] += 1
+        n_samples += 1
+        time.sleep(interval)
+    out = io.StringIO()
+    out.write(f"# wall-clock samples: {n_samples} at {hz:g} Hz over "
+              f"{seconds:g}s; lines are collapsed stacks "
+              f"(flamegraph.pl compatible)\n")
+    for (tname, stack), n in counts.most_common():
+        out.write(f"{tname};{stack} {n}\n")
+    return out.getvalue()

@@ -99,6 +99,21 @@ class OperationsServer:
                     from fabric_mod_tpu.observability.diag import (
                         dump_threads)
                     self._send(200, dump_threads().encode())
+                elif self.path.startswith("/debug/pprof"):
+                    # sampling CPU profile, collapsed-stack text
+                    # (reference: the pprof endpoints of the
+                    # operations server); ?seconds=N bounds the run
+                    from urllib.parse import parse_qs, urlparse
+                    from fabric_mod_tpu.observability.diag import (
+                        sample_profile)
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        secs = min(30.0, float(
+                            (q.get("seconds") or ["5"])[0]))
+                    except ValueError:
+                        self._send(400, b"bad seconds parameter")
+                        return
+                    self._send(200, sample_profile(secs).encode())
                 elif self.path.startswith("/participation/"):
                     self._participation("GET")
                 else:

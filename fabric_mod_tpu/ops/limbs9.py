@@ -202,13 +202,27 @@ def carry_mod_r(x: jnp.ndarray) -> jnp.ndarray:
 # Trace-time constant source override: Pallas kernels may not capture
 # array constants, so while a kernel body is being traced this hook
 # maps the module's numpy constant singletons (by IDENTITY) to values
-# read from kernel input refs.  None outside kernel tracing.
-CONST_LOOKUP = None
+# read from kernel input refs.  THREAD-LOCAL: a concurrent trace of
+# the ordinary XLA path on another thread must never observe a Pallas
+# kernel's in-flight hook (leaked tracers otherwise).
+import threading as _threading
+
+_TRACE_TLS = _threading.local()
+
+
+def set_const_lookup(fn) -> None:
+    """Install/clear (None) this thread's constant-source hook."""
+    _TRACE_TLS.const_lookup = fn
+
+
+def get_const_lookup():
+    return getattr(_TRACE_TLS, "const_lookup", None)
 
 
 def const_jnp(arr: np.ndarray) -> jnp.ndarray:
-    if CONST_LOOKUP is not None:
-        got = CONST_LOOKUP(arr)
+    hook = get_const_lookup()
+    if hook is not None:
+        got = hook(arr)
         if got is not None:
             return got
     return jnp.asarray(arr)
@@ -252,19 +266,24 @@ def sb_mul_cols(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return const_dot(_COLSUM, outer.reshape((K * K,) + outer.shape[2:]))
 
 
-# When True, the sequential low-carry unrolls to straight-line code
-# with STATIC row indices — required inside Pallas kernels (Mosaic's
-# dynamic sublane indexing is the risk) and a compile-time/runtime
-# trade elsewhere.  Trace-time flag: set it around tracing, not calls.
-UNROLL_LOW_CARRY = False
+# When True (per-thread), the sequential low-carry unrolls to
+# straight-line code with STATIC row indices — required inside Pallas
+# kernels (Mosaic's dynamic sublane indexing is the risk) and a
+# compile-time/runtime trade elsewhere.
+def set_unroll_low_carry(flag: bool) -> None:
+    _TRACE_TLS.unroll_low_carry = flag
+
+
+def get_unroll_low_carry() -> bool:
+    return getattr(_TRACE_TLS, "unroll_low_carry", False)
 
 
 def _exact_low_carry(s: jnp.ndarray) -> jnp.ndarray:
     """Exact carry out of the low K limbs of s (value ≡ 0 mod R).
 
     Sequential by nature; fori_loop so the body compiles once (or
-    unrolled under UNROLL_LOW_CARRY, see above)."""
-    if UNROLL_LOW_CARRY:
+    unrolled under set_unroll_low_carry, see above)."""
+    if get_unroll_low_carry():
         c = jnp.zeros(s.shape[1:], _F)
         for i in range(K):
             c = jnp.floor((s[i] + c) * (1.0 / BASE))

@@ -232,6 +232,20 @@ def on_curve(xm: jnp.ndarray, ym: jnp.ndarray) -> jnp.ndarray:
 
 # --- The jitted verify core ------------------------------------------------
 
+def build_q_table(q1, inf_pt, fp: FieldSpec, b_m):
+    """[inf, Q, 2Q, ..., 15Q] as a list of projective points — the
+    per-lane window table schedule (7 doublings + 7 additions),
+    shared by the XLA ladder and the Pallas kernel so the two can
+    never diverge."""
+    qtab = [inf_pt, q1]
+    for i in range(2, TABLE):
+        if i % 2 == 0:
+            qtab.append(point_double(qtab[i // 2], fp, b_m))
+        else:
+            qtab.append(point_add(qtab[i - 1], q1, fp, b_m))
+    return qtab
+
+
 def shamir_ladder(u1_w: jnp.ndarray, u2_w: jnp.ndarray,
                   qx_m: jnp.ndarray, qy_m: jnp.ndarray):
     """The windowed Shamir ladder: u1*G + u2*Q from MSB-first window
@@ -242,14 +256,8 @@ def shamir_ladder(u1_w: jnp.ndarray, u2_w: jnp.ndarray,
     batch = qx_m.shape[1:]
     b_m = const_like(b_m_np, qx_m)
 
-    one_m = infinity(batch)[1]
-    q1 = (qx_m, qy_m, one_m)
-    qtab = [infinity(batch), q1]
-    for i in range(2, TABLE):
-        if i % 2 == 0:
-            qtab.append(point_double(qtab[i // 2], fp, b_m))
-        else:
-            qtab.append(point_add(qtab[i - 1], q1, fp, b_m))
+    qtab = build_q_table((qx_m, qy_m, infinity(batch)[1]),
+                         infinity(batch), fp, b_m)
     q_table = tuple(
         jnp.stack([pt[c] for pt in qtab], axis=0)    # (TABLE, K, batch)
         for c in range(3))
@@ -430,9 +438,13 @@ def batch_verify(digests: np.ndarray, r_bytes: np.ndarray,
         # program across chips, which it cannot do for the
         # single-device pallas_call
         batch = digests.shape[0]
-        tile = next(t for t in (128, 64, 32, 16, 8, 4, 2, 1)
-                    if batch % t == 0)
-        core = _pallas_core(tile)
+        tile = next(t for t in (128, 64, 32, 16, 8)
+                    if batch % t == 0) if batch % 8 == 0 else None
+        if tile is not None:
+            core = _pallas_core(tile)
+        # else: an odd direct-caller batch (bccsp buckets are all
+        # multiples of 8) — a lane width under 8 would make the grid
+        # pathological, so stay on the XLA core
     ok = core(*(_dev(a, s) for a, s in zip(core_args, shardings)))
     return np.asarray(ok) & range_ok
 

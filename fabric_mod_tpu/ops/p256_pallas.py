@@ -76,8 +76,8 @@ def _ladder_kernel(sel1_ref, sel2_ref, qx_ref, qy_ref,
         id(fp.np_mat): npmat_ref[...],
         id(fp.p_mat): pmat_ref[...],
     }
-    old_hook = limbs.CONST_LOOKUP
-    limbs.CONST_LOOKUP = lambda arr: const_map.get(id(arr))
+    old_hook = limbs.get_const_lookup()
+    limbs.set_const_lookup(lambda arr: const_map.get(id(arr)))
     try:
         b_m = bm_ref[...]                            # (K, 1)
         one_m = jnp.broadcast_to(onemont_ref[...], (K, t))
@@ -85,14 +85,11 @@ def _ladder_kernel(sel1_ref, sel2_ref, qx_ref, qy_ref,
 
         @pl.when(nw == 0)
         def _init():
-            # per-lane window table [inf, Q, 2Q, ..., 15Q]
+            # per-lane window table, shared schedule with the XLA
+            # ladder (p256.build_q_table)
             q1 = (qx_ref[...], qy_ref[...], one_m)
-            qtab = [(zero, one_m, zero), q1]
-            for i in range(2, TABLE):
-                if i % 2 == 0:
-                    qtab.append(point_double(qtab[i // 2], fp, b_m))
-                else:
-                    qtab.append(point_add(qtab[i - 1], q1, fp, b_m))
+            qtab = p256.build_q_table(q1, (zero, one_m, zero), fp,
+                                      b_m)
             qtx_ref[...] = jnp.concatenate([pt[0] for pt in qtab],
                                            axis=0)
             qty_ref[...] = jnp.concatenate([pt[1] for pt in qtab],
@@ -132,7 +129,7 @@ def _ladder_kernel(sel1_ref, sel2_ref, qx_ref, qy_ref,
             yo_ref[...] = accy_ref[...]
             zo_ref[...] = accz_ref[...]
     finally:
-        limbs.CONST_LOOKUP = old_hook
+        limbs.set_const_lookup(old_hook)
 
 
 @functools.partial(jax.jit, static_argnames=("tile", "interpret"))
@@ -166,8 +163,8 @@ def _ladder_call(u1_w, u2_w, qx_m, qy_m, tile: int = 128,
         g_flat,
     )
 
-    old = limbs.UNROLL_LOW_CARRY
-    limbs.UNROLL_LOW_CARRY = True          # static indices in-kernel
+    old = limbs.get_unroll_low_carry()
+    limbs.set_unroll_low_carry(True)       # static indices in-kernel
     try:
         out_shape = [jax.ShapeDtypeStruct((K, batch), _F)] * 3
         x, y, z = pl.pallas_call(
@@ -189,7 +186,7 @@ def _ladder_call(u1_w, u2_w, qx_m, qy_m, tile: int = 128,
         )(u1_w.astype(jnp.int32), u2_w.astype(jnp.int32), qx_m, qy_m,
           *(jnp.asarray(c) for c in consts))
     finally:
-        limbs.UNROLL_LOW_CARRY = old
+        limbs.set_unroll_low_carry(old)
     return x, y, z
 
 

@@ -35,12 +35,14 @@ class Channel:
     """One channel on one peer (reference: core/peer/peer.go Channel)."""
 
     def __init__(self, channel_id: str, ledger, verifier, bundle: Bundle,
-                 csp, vinfo: Optional[ValidationInfoProvider] = None):
+                 csp, vinfo: Optional[ValidationInfoProvider] = None,
+                 plugin_registry=None):
         self.channel_id = channel_id
         self.ledger = ledger
         self.verifier = verifier
         self._verifier = verifier
         self._csp = csp
+        self._plugin_registry = plugin_registry
         self._lock = threading.Lock()
         if vinfo is None:
             # lifecycle-backed: committed chaincode definitions resolve
@@ -128,7 +130,8 @@ class Channel:
             self._verifier, self._vinfo,
             tx_id_exists=self.ledger.tx_id_exists,
             config_apply=self._validate_and_apply_config,
-            state_metadata=state_vp)
+            state_metadata=state_vp,
+            plugin_registry=self._plugin_registry)
         with self._lock:
             self._bundle = bundle
             self._validator = validator

@@ -51,12 +51,14 @@ def approval_key(cc_name: str, sequence: int, mspid: str) -> str:
 
 
 def _param_digest(version: str, sequence: int, policy: bytes,
-                  collections: bytes) -> bytes:
-    """Approvals bind to the EXACT definition parameters: an org that
-    approved (v1, policyA) has not approved (v1, policyB)."""
+                  collections: bytes, plugin: str) -> bytes:
+    """Approvals bind to the EXACT definition parameters — including
+    the validation plugin: an org that approved (v1, policyA, vscc)
+    has not approved (v1, policyA, some-permissive-plugin) (reference:
+    the ValidationParameter digest covers the plugin)."""
     h = hashlib.sha256()
     for part in (version.encode(), str(sequence).encode(), policy,
-                 collections):
+                 collections, plugin.encode()):
         h.update(len(part).to_bytes(4, "big"))
         h.update(part)
     return h.digest()
@@ -92,11 +94,13 @@ class LifecycleContract:
         sequence = int(stub.args[3].decode())
         policy = stub.args[4] if len(stub.args) > 4 else b""
         collections = stub.args[5] if len(stub.args) > 5 else b""
+        plugin = (stub.args[6].decode()
+                  if len(stub.args) > 6 and stub.args[6] else "vscc")
         if collections:                     # must decode as a package
             m.CollectionConfigPackage.decode(collections)
         if "/" in name:
             raise ChaincodeError(f"invalid chaincode name {name!r}")
-        return name, version, sequence, policy, collections
+        return name, version, sequence, policy, collections, plugin
 
     def _check_sequence(self, stub: ChaincodeStub, name: str,
                         sequence: int) -> None:
@@ -129,7 +133,7 @@ class LifecycleContract:
             # approving org is the tx CREATOR's org; the key embeds it
             # so one org can never write another org's approval, and
             # validation pins this tx to that org's Endorsement policy
-            name, version, sequence, policy, collections = \
+            name, version, sequence, policy, collections, plugin = \
                 self._def_args(stub)
             mspid = stub.creator_mspid()
             if not mspid:
@@ -137,15 +141,16 @@ class LifecycleContract:
             self._check_sequence(stub, name, sequence)
             stub.put_state(
                 approval_key(name, sequence, mspid),
-                _param_digest(version, sequence, policy, collections))
+                _param_digest(version, sequence, policy, collections,
+                              plugin))
             return b"ok"
 
         if op == "checkcommitreadiness":
             # (reference: CheckCommitReadiness, scc.go)
-            name, version, sequence, policy, collections = \
+            name, version, sequence, policy, collections, plugin = \
                 self._def_args(stub)
             digest = _param_digest(version, sequence, policy,
-                                   collections)
+                                   collections, plugin)
             ready = self._approvals(stub, name, sequence, digest)
             return json.dumps(ready, sort_keys=True).encode()
 
@@ -158,12 +163,12 @@ class LifecycleContract:
             return got.hex().encode() if got else b""
 
         if op == "commit":
-            name, version, sequence, policy, collections = \
+            name, version, sequence, policy, collections, plugin = \
                 self._def_args(stub)
             self._check_sequence(stub, name, sequence)
             if self._channel_orgs is not None:
                 digest = _param_digest(version, sequence, policy,
-                                       collections)
+                                       collections, plugin)
                 ready = self._approvals(stub, name, sequence, digest)
                 yes = sum(ready.values())
                 # MAJORITY of application orgs (the channel default
@@ -176,7 +181,7 @@ class LifecycleContract:
                         f"(need {need}): {ready}")
             d = m.ChaincodeDefinition(
                 sequence=sequence, version=version,
-                endorsement_policy=policy, validation_plugin="vscc",
+                endorsement_policy=policy, validation_plugin=plugin,
                 collections=collections)
             stub.put_state(definition_key(name), d.encode())
             return b"ok"

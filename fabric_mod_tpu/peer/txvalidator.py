@@ -113,12 +113,20 @@ class TxValidator:
                  tx_id_exists: Optional[Callable[[str], bool]] = None,
                  config_apply: Optional[Callable[[m.Envelope], None]] = None,
                  state_metadata: Optional[Callable[[str, str],
-                                                   Optional[bytes]]] = None):
+                                                   Optional[bytes]]] = None,
+                 plugin_registry=None):
         self.channel_id = channel_id
         self._msp_mgr = msp_mgr
         self._policy_eval = policy_eval
         self._verifier = verifier
         self._vinfo = vinfo
+        # named validation plugins (reference: handlers/library
+        # registry.go:79); definitions naming an unknown plugin fail
+        # closed in _stage_tx
+        if plugin_registry is None:
+            from fabric_mod_tpu.peer.plugins import PluginRegistry
+            plugin_registry = PluginRegistry()
+        self._plugins = plugin_registry
         self._tx_id_exists = tx_id_exists or (lambda _txid: False)
         # CONFIG txs: validated + applied through the channel config
         # machinery (reference: txvalidator/v20/validator.go:400-421 —
@@ -203,12 +211,20 @@ class TxValidator:
                     return
                 ns = (cca.chaincode_id.name
                       if cca.chaincode_id is not None else "")
-                _plugin, policy_bytes = self._resolve_vinfo(ns, cca)
+                plugin_name, policy_bytes = self._resolve_vinfo(ns, cca)
+                evaluator = self._plugins.resolve(plugin_name,
+                                                  self._policy_eval)
+                if evaluator is None:
+                    # definition names a plugin this peer does not
+                    # have: fail closed (reference: plugindispatcher's
+                    # missing-plugin error -> invalid tx)
+                    work.flag = V.INVALID_OTHER_REASON
+                    return
                 sds = [SignedData(data=prp_bytes + e.endorser,
                                   identity=e.endorser,
                                   signature=e.signature)
                        for e in endorsements]
-                cc_pending = self._policy_eval.prepare(
+                cc_pending = evaluator.prepare(
                     policy_bytes, sds, collector)
                 key_evals = self._stage_key_policies(
                     cca, sds, collector, inblock_vp, work)

@@ -90,3 +90,42 @@ def test_ops_server_endpoints():
     finally:
         srv.stop()
         activate_spec("info")
+
+
+def test_pprof_sampling_profile(tmp_path):
+    """/debug/pprof returns collapsed stacks with sample counts
+    attributing a busy thread (the pprof-analog, SURVEY §5.1)."""
+    import threading
+    import time
+    import urllib.request
+    from fabric_mod_tpu.observability import (
+        HealthRegistry, OperationsServer, default_provider)
+
+    stop = threading.Event()
+
+    def busy_loop():
+        while not stop.is_set():
+            sum(i * i for i in range(2000))
+
+    t = threading.Thread(target=busy_loop, name="busyworker",
+                         daemon=True)
+    t.start()
+    ops = OperationsServer("127.0.0.1", 0, default_provider(),
+                           HealthRegistry())
+    ops.start()
+    try:
+        host, port = ops.addr
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/debug/pprof?seconds=0.5",
+                timeout=10) as r:
+            text = r.read().decode()
+        assert "collapsed stacks" in text
+        assert "busyworker" in text
+        # count column parses
+        lines = [ln for ln in text.splitlines()
+                 if ln and not ln.startswith("#")]
+        assert lines and all(ln.rsplit(" ", 1)[1].isdigit()
+                             for ln in lines)
+    finally:
+        stop.set()
+        ops.stop()

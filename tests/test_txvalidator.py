@@ -267,3 +267,54 @@ def test_committer_pipeline_with_mvcc(world, tmp_path):
                prev=led.blockstore.last_block_hash))
     assert flags2 == [V.VALID, V.MVCC_READ_CONFLICT]
     led.close()
+
+
+# --- named validation plugins (reference: handlers/library/registry.go) ---
+
+class _VetoPending:
+    def finish(self, _mask):
+        return False
+
+
+class _VetoEvaluator:
+    """A plugin that rejects every action (stages nothing)."""
+
+    def prepare(self, _policy, _sds, _collector):
+        return _VetoPending()
+
+
+def _plugin_vinfo(plugin_name):
+    class V:
+        def validation_info(self, ns):
+            return plugin_name, _default_policy()
+    return V()
+
+
+def test_registered_plugin_overrides_builtin_vscc(world):
+    from fabric_mod_tpu.peer.plugins import PluginRegistry
+    reg = PluginRegistry()
+    reg.register("veto", _VetoEvaluator)
+    validator = TxValidator(
+        CHANNEL, world["mgr"],
+        ApplicationPolicyEvaluator(world["mgr"]),
+        CountingVerifier(), _plugin_vinfo("veto"),
+        plugin_registry=reg)
+    # perfectly endorsed tx — the veto plugin still rejects it
+    flags = validator.validate(_block([_tx(world)]))
+    assert flags == [V.ENDORSEMENT_POLICY_FAILURE]
+
+
+def test_unknown_plugin_fails_closed(world):
+    validator = TxValidator(
+        CHANNEL, world["mgr"],
+        ApplicationPolicyEvaluator(world["mgr"]),
+        CountingVerifier(), _plugin_vinfo("no-such-plugin"))
+    flags = validator.validate(_block([_tx(world)]))
+    assert flags == [V.INVALID_OTHER_REASON]
+
+
+def test_vscc_name_resolves_to_builtin(world):
+    validator, _ = _validator(world)
+    assert validator._plugins.names() == ["vscc"]
+    flags = validator.validate(_block([_tx(world)]))
+    assert flags == [V.VALID]
