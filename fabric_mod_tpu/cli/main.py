@@ -12,7 +12,8 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv:
         print("usage: fabric-mod-tpu {cryptogen|configtxgen|"
-              "configtxlator|idemixgen|discover|node|ledger} ...",
+              "configtxlator|idemixgen|discover|node|ledger|"
+              "chaincode} ...",
               file=sys.stderr)
         return 2
     tool, rest = argv[0], argv[1:]
@@ -30,6 +31,8 @@ def main(argv=None) -> int:
         from fabric_mod_tpu.cli.node import main as run
     elif tool == "ledger":
         from fabric_mod_tpu.cli.ledgerutil import main as run
+    elif tool == "chaincode":
+        from fabric_mod_tpu.cli.chaincode import main as run
     else:
         print(f"unknown tool {tool!r}", file=sys.stderr)
         return 2

@@ -290,10 +290,12 @@ def run_orderer(node_id: str, genesis_path: str, crypto_dir: str,
 
 def run_peer(org: str, genesis_path: str, crypto_dir: str,
              data_dir: str, orderer_addresses: list,
-             peer_cfg: PeerConfig, tls=None, stop_event=None) -> None:
+             peer_cfg: PeerConfig, tls=None, stop_event=None,
+             peer_listen: str = "127.0.0.1:0") -> None:
     """A standalone committing peer (reference: internal/peer/node/
     start.go:205): ledger + channel + MCS-verified pipelined deliver
-    client pulling from the ordering service with endpoint failover."""
+    client with endpoint failover + the gRPC endorsement service on
+    `peer_listen`."""
     init_logging(default_provider(), peer_cfg.log_spec)
     csp = SwCSP()
     with open(genesis_path, "rb") as f:
@@ -327,13 +329,27 @@ def run_peer(org: str, genesis_path: str, crypto_dir: str,
         target=lambda: client.run(idle_timeout_s=3600.0), daemon=True)
     runner.start()
 
+    # the endorsement surface (reference: core/endorser's
+    # ProcessProposal service registered at node start): user
+    # contract + system chaincodes + the lifecycle ceremony
+    from fabric_mod_tpu.peer.endorser import Endorser
+    from fabric_mod_tpu.peer.endorserserver import EndorserServer
+    from fabric_mod_tpu.peer.scc import build_default_registry
+    peer_signer = _load_signer(crypto_dir, org, "peer", csp)
+    endorser = Endorser(channel, build_default_registry(channel, ledger),
+                        peer_signer)
+    eserver = EndorserServer(endorser, peer_listen,
+                             server_cert_pem=tls.get("server.crt"),
+                             server_key_pem=tls.get("server.key"))
+    eserver.start()
+
     health = HealthRegistry()
     health.register("ledger", lambda: None if ledger.height > 0 else
                     (_ for _ in ()).throw(RuntimeError("empty ledger")))
     ops = _start_ops(peer_cfg, health)
-    log.info("peer (%s): channel %s at height %d, orderers %s, ops "
-             "on %s", org, cid, ledger.height, orderer_addresses,
-             ops.addr)
+    log.info("peer (%s): channel %s at height %d, endorser on port "
+             "%d, orderers %s, ops on %s", org, cid, ledger.height,
+             eserver.port, orderer_addresses, ops.addr)
 
     stop = stop_event or threading.Event()
     _install_stop_signals(stop)
@@ -342,6 +358,7 @@ def run_peer(org: str, genesis_path: str, crypto_dir: str,
     # join the puller/committer before closing stores: a commit in
     # flight must not race the ledger's file handles going away
     runner.join(timeout=10)
+    eserver.stop()
     ops.stop()
     ledger_mgr.close()
 
@@ -366,6 +383,8 @@ def main(argv=None) -> int:
                     help="id=host:port,... raft cluster map")
     ap.add_argument("--orderers", default="",
                     help="peer role: comma-separated deliver endpoints")
+    ap.add_argument("--peer-listen", default="127.0.0.1:0",
+                    help="peer role: endorsement service address")
     ap.add_argument("--tls-dir", default="",
                     help="dir with ca.crt server.crt server.key "
                          "[client.crt client.key]")
@@ -383,7 +402,8 @@ def main(argv=None) -> int:
     elif args.role == "peer":
         addrs = [a for a in args.orderers.split(",") if a]
         run_peer(args.org, args.genesis, args.crypto, args.data,
-                 addrs, peer_cfg, tls=tls)
+                 addrs, peer_cfg, tls=tls,
+                 peer_listen=args.peer_listen)
     else:
         run_node(args.genesis, args.crypto, args.orderer_org,
                  args.data, peer_cfg)

@@ -108,17 +108,11 @@ class Network:
         if self.ledger.height == 0:
             self.channel.init_from_genesis(self.genesis_block)
 
-        # chaincode + endorsers (user contract + the system chaincodes)
-        from fabric_mod_tpu.peer.lifecycle import (
-            LIFECYCLE_NS, LifecycleContract)
-        from fabric_mod_tpu.peer.scc import CsccContract, QsccContract
-        self.chaincodes = ChaincodeRegistry()
-        self.chaincodes.register("mycc", KvContract())
-        self.chaincodes.register(LIFECYCLE_NS, LifecycleContract(
-            channel_orgs=lambda: list(
-                self.channel.bundle().application.org_mspids)))
-        self.chaincodes.register("qscc", QsccContract(self.ledger))
-        self.chaincodes.register("cscc", CsccContract(self.channel))
+        # chaincode + endorsers (user contract + the system
+        # chaincodes; wiring shared with the real peer process)
+        from fabric_mod_tpu.peer.scc import build_default_registry
+        self.chaincodes = build_default_registry(self.channel,
+                                                 self.ledger)
         self.endorsers: Dict[str, Endorser] = {
             org: Endorser(self.channel, self.chaincodes,
                           self.peer_signers[org])

@@ -24,7 +24,8 @@ unrolled vreg lists, no dynamic sublane indexing):
 * the G table is a host constant folded in with a precision-pinned
   dot;
 * all field math is ops/limbs9 — inside the kernel the sequential
-  low-carry unrolls to static row indices (limbs9.UNROLL_LOW_CARRY).
+  low-carry unrolls to static row indices
+  (limbs9.set_unroll_low_carry, thread-local).
 
 The kernel is numerically IDENTICAL to the XLA ladder (same formulas,
 same order), differentially tested in interpret mode; flip it on in
@@ -69,7 +70,8 @@ def _ladder_kernel(sel1_ref, sel2_ref, qx_ref, qy_ref,
 
     # Pallas kernels may not capture array constants; the limb layer's
     # fold matrices arrive as inputs and are routed into limbs9's
-    # mont ops via the identity-keyed CONST_LOOKUP hook (trace-time).
+    # mont ops via the identity-keyed, THREAD-LOCAL constant hook
+    # (limbs9.set_const_lookup, trace-time).
     const_map = {
         id(limbs._COLSUM): colsum_ref[...],
         id(limbs._COLSUM_SQR): colsum_sqr_ref[...],

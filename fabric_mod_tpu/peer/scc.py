@@ -89,3 +89,24 @@ class CsccContract:
             return json.dumps(
                 [self._channel.channel_id]).encode()
         raise ChaincodeError(f"unknown cscc op {op!r}")
+
+
+def build_default_registry(channel, ledger):
+    """The standard per-peer chaincode registry: user contract +
+    system chaincodes + the lifecycle ceremony wired to the channel's
+    application orgs (reference: the SCC registrations of
+    internal/peer/node/start.go).  Shared by the e2e network and the
+    real peer process so their wiring can never drift."""
+    from fabric_mod_tpu.peer.chaincode import (
+        ChaincodeRegistry, KvContract)
+    from fabric_mod_tpu.peer.lifecycle import (
+        LIFECYCLE_NS, LifecycleContract)
+
+    registry = ChaincodeRegistry()
+    registry.register("mycc", KvContract())
+    registry.register(LIFECYCLE_NS, LifecycleContract(
+        channel_orgs=lambda: list(
+            channel.bundle().application.org_mspids)))
+    registry.register("qscc", QsccContract(ledger))
+    registry.register("cscc", CsccContract(channel))
+    return registry

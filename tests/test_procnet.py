@@ -80,17 +80,12 @@ class ProcNet:
         self.logs = {}
         self.tls = TlsCA()
         self.o_ids = ["o0", "o1", "o2"]
-        (self.bport0, self.bport1, self.bport2,
-         self.cport0, self.cport1, self.cport2,
-         self.oops0, self.oops1, self.oops2,
-         self.pops0, self.pops1) = _free_ports(11)
-        self.bports = dict(zip(self.o_ids,
-                               (self.bport0, self.bport1, self.bport2)))
-        self.cports = dict(zip(self.o_ids,
-                               (self.cport0, self.cport1, self.cport2)))
-        self.oops = dict(zip(self.o_ids,
-                             (self.oops0, self.oops1, self.oops2)))
-        self.pops = {"p0": self.pops0, "p1": self.pops1}
+        ports = _free_ports(13)
+        self.bports = dict(zip(self.o_ids, ports[0:3]))
+        self.cports = dict(zip(self.o_ids, ports[3:6]))
+        self.oops = dict(zip(self.o_ids, ports[6:9]))
+        self.pops = {"p0": ports[9], "p1": ports[10]}
+        self.eports = {"p0": ports[11], "p1": ports[12]}
         self._build_artifacts()
 
     # -- artifacts (cryptogen + configtxgen + TLS) ------------------------
@@ -144,8 +139,13 @@ class ProcNet:
                     f.write(data)
         d = os.path.join(self.root, "tls", "peer")
         os.makedirs(d)
-        with open(os.path.join(d, "ca.crt"), "wb") as f:
-            f.write(self.tls.cert_pem)
+        pcert, pkey = self.tls.issue(
+            "peer.example.com", sans=("localhost", "127.0.0.1"))
+        for name, data in (("ca.crt", self.tls.cert_pem),
+                           ("server.crt", pcert),
+                           ("server.key", pkey)):
+            with open(os.path.join(d, name), "wb") as f:
+                f.write(data)
 
     # -- process control ---------------------------------------------------
     def _spawn(self, name, args, ops_port):
@@ -184,6 +184,7 @@ class ProcNet:
             "--genesis", self.genesis, "--crypto", self.crypto_dir,
             "--data", os.path.join(self.root, "data", pid),
             "--orderers", orderers,
+            "--peer-listen", f"127.0.0.1:{self.eports[pid]}",
             "--tls-dir", os.path.join(self.root, "tls", "peer"),
         ], self.pops[pid])
 
@@ -324,3 +325,54 @@ def test_process_network_survives_leader_kill(procnet):
     assert _wait(lambda: len({
         net.orderer_channels(o)["channels"][0]["height"]
         for o in survivors}) == 1, t=30), f"divergent heights {heights}"
+
+
+def test_chaincode_cli_invoke_and_query_across_processes(procnet):
+    """The operator surface end to end: `chaincode invoke` endorses on
+    BOTH peers' gRPC endorser services, broadcasts to the raft
+    orderer, commits everywhere; `chaincode query` reads it back from
+    each peer (reference: internal/peer/chaincode)."""
+    from fabric_mod_tpu.cli.chaincode import main as chaincode_main
+
+    net = procnet
+    net.start_all()
+    assert _wait(lambda: net.leader() is not None, t=60)
+    assert _wait(lambda: all(net.peer_height(p) >= 1
+                             for p in ("p0", "p1")), t=60)
+
+    peers = ",".join(f"127.0.0.1:{net.eports[p]}" for p in ("p0", "p1"))
+    rc = chaincode_main([
+        "invoke", "--channel", "procchan", "--name", "mycc",
+        "--args", "put,clikey,clivalue",
+        "--crypto", net.crypto_dir, "--org", "Org1", "--user", "user0",
+        "--peers", peers,
+        "--orderer", f"127.0.0.1:{net.bports['o0']}",
+        "--tls-ca", os.path.join(net.root, "tls", "peer", "ca.crt"),
+    ])
+    assert rc == 0
+    # both peers commit the invoke
+    assert _wait(lambda: all((net.peer_height(p) or 0) >= 2
+                             for p in ("p0", "p1")), t=60)
+
+    import io
+    import contextlib
+    for p in ("p0", "p1"):
+        buf = io.BytesIO()
+
+        class _Out:
+            buffer = buf
+            @staticmethod
+            def write(s):
+                pass
+        with contextlib.redirect_stdout(_Out()):
+            rc = chaincode_main([
+                "query", "--channel", "procchan", "--name", "mycc",
+                "--args", "get,clikey",
+                "--crypto", net.crypto_dir, "--org", "Org1",
+                "--user", "user0",
+                "--peers", f"127.0.0.1:{net.eports[p]}",
+                "--tls-ca", os.path.join(net.root, "tls", "peer",
+                                         "ca.crt"),
+            ])
+        assert rc == 0
+        assert buf.getvalue() == b"clivalue", (p, buf.getvalue())
