@@ -13,7 +13,8 @@ Design (KERNEL_PLAN.md §2-3):
   precomputed ONCE per issuer on host as a static schedule of sparse
   line coefficients.  The device work is only the per-signature line
   evaluation l(P_i) and the Fp12 square/multiply chain, batched over
-  signatures on the limb layer of ops/limbs.py (batch axis = lanes).
+  signatures on the f32/MXU limb layer of ops/limbs9.py (batch axis =
+  lanes).
 * Sparse lines: with the M-type twist untwist psi(x',y') =
   (x' v^2/xi, y' v w/xi), the line through T with slope lam' evaluated
   at an Fp point (xP, yP) is
@@ -27,9 +28,12 @@ Design (KERNEL_PLAN.md §2-3):
 * Equality checks e(A,W) == e(Abar,g2) run as
   e(A,W)·e(−Abar,g2) == 1: two Miller loops, one shared final exp.
 
-Field elements are (..., K) int32 lazy limbs in the Montgomery domain
-(ops/limbs.py); Fp2/Fp6/Fp12 are nested tuples (pytrees), broadcast
-over leading batch axes.
+Field elements are (K, batch) f32 lazy limbs in the Montgomery domain
+of the MXU limb layer (ops/limbs9.py — limb axis FIRST, schoolbook
+fold + Montgomery constant products as precision-pinned matmuls);
+Fp2/Fp6/Fp12 are nested tuples (pytrees).  Per-step line constants
+stay bare (K,) vectors — the limb ops rank-align them against batched
+operands.
 """
 from __future__ import annotations
 
@@ -39,7 +43,7 @@ from typing import List, Tuple
 import numpy as np
 
 from fabric_mod_tpu.idemix import fp256bn as host
-from fabric_mod_tpu.ops import limbs
+from fabric_mod_tpu.ops import limbs9 as limbs
 
 SPEC = limbs.FieldSpec.make("fp256bn.p", host.P)
 _R = 1 << limbs.RBITS
@@ -69,11 +73,11 @@ def f2_sub(x, y):
 
 
 def f2_neg(x):
-    return (limbs.carry2(-x[0]), limbs.carry2(-x[1]))
+    return (limbs.carried(-x[0]), limbs.carried(-x[1]))
 
 
 def f2_conj(x):
-    return (x[0], limbs.carry2(-x[1]))
+    return (x[0], limbs.carried(-x[1]))
 
 
 def f2_mul(x, y):
@@ -106,7 +110,7 @@ def f2_inv(x):
         limbs.add(limbs.mont_sqr(x[0], SPEC), limbs.mont_sqr(x[1], SPEC)),
         SPEC)
     return (limbs.mont_mul(x[0], d, SPEC),
-            limbs.carry2(-limbs.mont_mul(x[1], d, SPEC)))
+            limbs.carried(-limbs.mont_mul(x[1], d, SPEC)))
 
 
 def f6_add(x, y):
@@ -222,9 +226,12 @@ def f12_frobenius(x):
 
 
 def f12_one(shape_like):
-    """Montgomery one broadcast to the batch shape of `shape_like`."""
+    """Montgomery one broadcast to the batch shape of `shape_like`
+    ((K, batch) leading-limb layout)."""
     import jax.numpy as jnp
-    one = jnp.broadcast_to(SPEC.one_mont, shape_like.shape).astype(jnp.int32)
+    one = jnp.broadcast_to(
+        limbs.const_like(SPEC.one_mont, shape_like),
+        shape_like.shape).astype(jnp.float32)
     zero = jnp.zeros_like(one)
     z2 = (zero, zero)
     return (((one, zero), z2, z2), (z2, z2, z2))
@@ -323,7 +330,7 @@ def _build_schedule(q: "host.G2") -> LineSchedule:
 # ---------------------------------------------------------------------------
 
 def miller_batch(xp_m, yp_m, sched: LineSchedule):
-    """Batched Miller loop: (batch, K) Montgomery G1 coords against one
+    """Batched Miller loop: (K, batch) Montgomery G1 coords against one
     precomputed schedule.  One lax.scan step = Fp12 sqr (skipped via
     select on add-steps) + sparse line mul."""
     import jax
@@ -415,10 +422,11 @@ def final_exp_batch(f):
 # ---------------------------------------------------------------------------
 
 def _g1_batch_to_mont_np(points) -> Tuple[np.ndarray, np.ndarray]:
-    """[host.G1] -> two (batch, K) canonical Montgomery limb arrays."""
-    xs = np.stack([_mont_np(p.x) for p in points])
-    ys = np.stack([_mont_np(p.y) for p in points])
-    return xs, ys
+    """[host.G1] -> two (K, batch) canonical Montgomery limb arrays
+    (the device layout: limb axis first)."""
+    xs = np.stack([_mont_np(p.x) for p in points], axis=-1)
+    ys = np.stack([_mont_np(p.y) for p in points], axis=-1)
+    return np.ascontiguousarray(xs), np.ascontiguousarray(ys)
 
 
 @functools.lru_cache(maxsize=8)
@@ -470,7 +478,7 @@ def pairing_batch(p_points, q: "host.G2"):
 def f12_to_host(dev_f12, index: int = 0) -> "host.Fp12":
     """One batch element of a device Fp12 -> host Fp12 (for tests)."""
     def fp_of(x):
-        canon = limbs.canonical(np.asarray(x)[index], SPEC)
+        canon = limbs.canonical(np.asarray(x)[:, index], SPEC)
         v = limbs.limbs_to_int(np.asarray(canon))
         return v * pow(_R, -1, host.P) % host.P
 

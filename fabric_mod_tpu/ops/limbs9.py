@@ -310,8 +310,23 @@ def _mont_reduce(t: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
     return carried(hi)
 
 
+def _align2(a: jnp.ndarray, b: jnp.ndarray):
+    """Rank-align two leading-limb-axis operands: a bare (K,) constant
+    against a (K, batch...) value reshapes to (K, 1, ...) — numpy's
+    trailing-axis broadcasting would otherwise reject (or worse,
+    misalign) the pair.  No-op when ranks agree."""
+    an = getattr(a, "ndim", 0)
+    bn = getattr(b, "ndim", 0)
+    if an < bn:
+        a = jnp.reshape(a, a.shape + (1,) * (bn - an))
+    elif bn < an:
+        b = jnp.reshape(b, b.shape + (1,) * (an - bn))
+    return a, b
+
+
 def mont_mul(a: jnp.ndarray, b: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
     """Montgomery product a*b*R^-1 mod p (lazy limbs in and out)."""
+    a, b = _align2(a, b)
     return _mont_reduce(carried(sb_mul_cols(a, b)), spec)
 
 
@@ -331,10 +346,12 @@ def mont_sqr(a: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    a, b = _align2(a, b)
     return carried(a + b)
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    a, b = _align2(a, b)
     return carried(a - b)
 
 

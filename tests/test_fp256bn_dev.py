@@ -17,7 +17,7 @@ import pytest
 from fabric_mod_tpu.idemix import credential as cred
 from fabric_mod_tpu.idemix import fp256bn as host
 from fabric_mod_tpu.ops import fp256bn_dev as dev
-from fabric_mod_tpu.ops import limbs
+from fabric_mod_tpu.ops import limbs9 as limbs
 
 rng = random.Random(2024)
 P = host.P
@@ -29,15 +29,15 @@ def rand_fp2():
 
 def to_dev_fp2(x, batch=2):
     arr = dev._mont_fp2_np(x)
-    return (np.broadcast_to(arr[0], (batch, limbs.K)).copy(),
-            np.broadcast_to(arr[1], (batch, limbs.K)).copy())
+    return (np.broadcast_to(arr[0][:, None], (limbs.K, batch)).copy(),
+            np.broadcast_to(arr[1][:, None], (limbs.K, batch)).copy())
 
 
 def from_dev_fp2(t, i=0):
     r_inv = pow(dev._R, -1, P)
 
     def fp(x):
-        c = limbs.canonical(np.asarray(x)[i], dev.SPEC)
+        c = limbs.canonical(np.asarray(x)[:, i], dev.SPEC)
         return limbs.limbs_to_int(np.asarray(c)) * r_inv % P
     return host.Fp2(fp(t[0]), fp(t[1]))
 
