@@ -56,6 +56,12 @@ def main(argv=None) -> int:
                     help="PEM bundle to verify TLS servers")
     ap.add_argument("--tls-authority", default="",
                     help="expected TLS server name override")
+    ap.add_argument("--wait-event", action="store_true",
+                    help="after broadcast, wait on the first peer's "
+                    "DeliverFiltered stream for the tx's validation "
+                    "code (reference: peer chaincode invoke "
+                    "--waitForEvent)")
+    ap.add_argument("--wait-timeout", type=float, default=30.0)
     args = ap.parse_args(argv)
 
     root_pem = None
@@ -85,8 +91,28 @@ def main(argv=None) -> int:
                              or None)
         bcast = GrpcBroadcaster(oclient)
         try:
+            from fabric_mod_tpu.peer.deliverevents import (
+                EventDeliverClient)
+            wait_start = 0
+            if args.wait_event:
+                # pin the subscription numerically BEFORE broadcasting:
+                # the tx can only commit at a block >= the peer's
+                # current height, so a stream starting there can never
+                # miss it, and the peer never re-serves old history
+                import json
+                info = json.loads(query_remote(
+                    args.channel, "qscc", [b"GetChainInfo"], signer,
+                    endorsers[0]))
+                wait_start = int(info["height"])
             tx_id = invoke_remote(args.channel, args.name, cc_args,
                                   signer, endorsers, bcast)
+            if args.wait_event:
+                waiter = EventDeliverClient(clients[0], args.channel,
+                                            signer)
+                code = waiter.wait_for_tx(tx_id, start=wait_start,
+                                          timeout_s=args.wait_timeout)
+                print(f"{tx_id} {code}")
+                return 0 if code == 0 else 3   # 0 == VALID
             print(tx_id)
             return 0
         finally:

@@ -332,23 +332,35 @@ def run_peer(org: str, genesis_path: str, crypto_dir: str,
     # the endorsement surface (reference: core/endorser's
     # ProcessProposal service registered at node start): user
     # contract + system chaincodes + the lifecycle ceremony
+    from fabric_mod_tpu.comm.grpc_comm import GRPCServer
+    from fabric_mod_tpu.peer.aclmgmt import ACLProvider
+    from fabric_mod_tpu.peer.deliverevents import EventDeliverServer
     from fabric_mod_tpu.peer.endorser import Endorser
     from fabric_mod_tpu.peer.endorserserver import EndorserServer
     from fabric_mod_tpu.peer.scc import build_default_registry
     peer_signer = _load_signer(crypto_dir, org, "peer", csp)
     endorser = Endorser(channel, build_default_registry(channel, ledger),
                         peer_signer)
-    eserver = EndorserServer(endorser, peer_listen,
-                             server_cert_pem=tls.get("server.crt"),
-                             server_key_pem=tls.get("server.key"))
-    eserver.start()
+    # one listener for every peer-facing service (endorsement + client
+    # events), like the reference's single peer gRPC server
+    # worker headroom: event streams park threads at the chain tip
+    # (EventDeliverServer caps them at 40), endorsement must always
+    # find a free worker beyond that cap
+    pserver = GRPCServer(peer_listen,
+                         server_cert_pem=tls.get("server.crt"),
+                         server_key_pem=tls.get("server.key"),
+                         max_workers=64)
+    eserver = EndorserServer(endorser, grpc=pserver)
+    acl = ACLProvider(channel.bundle, verify_many=verifier.verify_many)
+    events = EventDeliverServer(cid, ledger, acl, grpc=pserver)
+    pserver.start()
 
     health = HealthRegistry()
     health.register("ledger", lambda: None if ledger.height > 0 else
                     (_ for _ in ()).throw(RuntimeError("empty ledger")))
     ops = _start_ops(peer_cfg, health)
-    log.info("peer (%s): channel %s at height %d, endorser on port "
-             "%d, orderers %s, ops on %s", org, cid, ledger.height,
+    log.info("peer (%s): channel %s at height %d, endorser+events on "
+             "port %d, orderers %s, ops on %s", org, cid, ledger.height,
              eserver.port, orderer_addresses, ops.addr)
 
     stop = stop_event or threading.Event()
@@ -358,7 +370,8 @@ def run_peer(org: str, genesis_path: str, crypto_dir: str,
     # join the puller/committer before closing stores: a commit in
     # flight must not race the ledger's file handles going away
     runner.join(timeout=10)
-    eserver.stop()
+    events.stop()           # wakes tip-parked deliver handlers first
+    pserver.stop(1.0)
     ops.stop()
     ledger_mgr.close()
 

@@ -229,6 +229,9 @@ class KvLedger:
         self._durable = durable
         os.makedirs(ledger_dir, exist_ok=True)
         self._lock = threading.RLock()
+        # commit notification for event deliver streams (reference:
+        # the ledger's CommitNotifier consumed by deliverevents.go)
+        self.height_changed = threading.Condition()
         self.blockstore = BlockStore(os.path.join(ledger_dir, "chains"))
         self._state_path = os.path.join(ledger_dir, "state.snap")
         if durable:
@@ -401,7 +404,9 @@ class KvLedger:
                 self.blockstore.height)
             if not self._durable and (num + 1) % self.SNAPSHOT_EVERY == 0:
                 self.state.snapshot(self._state_path)
-            return flags
+        with self.height_changed:
+            self.height_changed.notify_all()
+        return flags
 
     def _commit_pvt(self, num: int, txs, flags) -> None:
         """Apply plaintext private writes for VALID txs whose hashes

@@ -60,7 +60,15 @@ HALF = BASE // 2  # rounding offset
 
 # Exact f32 dot emulation on TPU (6-pass bf16). The operands here are
 # integers < 2^17 and sums < 2^22, so HIGHEST is bit-exact.
-PRECISION = jax.lax.Precision.HIGHEST
+# FABRIC_MOD_TPU_PRECISION=high selects the cheaper 3-pass emulation
+# for an on-chip A/B: it is exact ONLY for the 0/1 fold matrices (see
+# the split analysis below) — the differential suite must pass before
+# a HIGH number is trusted.
+import os as _os
+
+PRECISION = (jax.lax.Precision.HIGH
+             if _os.environ.get("FABRIC_MOD_TPU_PRECISION", "").lower()
+             == "high" else jax.lax.Precision.HIGHEST)
 
 _F = jnp.float32
 
@@ -274,8 +282,13 @@ def set_unroll_low_carry(flag: bool) -> None:
     _TRACE_TLS.unroll_low_carry = flag
 
 
+# env default lets bench variants A/B this without code changes
+_UNROLL_DEFAULT = _os.environ.get(
+    "FABRIC_MOD_TPU_UNROLL_LOW_CARRY", "") == "1"
+
+
 def get_unroll_low_carry() -> bool:
-    return getattr(_TRACE_TLS, "unroll_low_carry", False)
+    return getattr(_TRACE_TLS, "unroll_low_carry", _UNROLL_DEFAULT)
 
 
 def _exact_low_carry(s: jnp.ndarray) -> jnp.ndarray:

@@ -82,8 +82,9 @@ class OrdererServer:
                     status=m.Status.NOT_FOUND).encode()
                 return
             svc = DeliverService(support)
-            start = self._seek_number(seek.start, support, newest_tip=True)
-            stop = self._seek_number(seek.stop, support, newest_tip=False)
+            h = support.store.height
+            start = protoutil.seek_number(seek.start, h, newest_tip=True)
+            stop = protoutil.seek_number(seek.stop, h, newest_tip=False)
             stop_event = threading.Event()
             cb = context.add_callback(stop_event.set)
             for block in svc.blocks(start, stop=stop,
@@ -91,21 +92,6 @@ class OrdererServer:
                                     timeout_s=30.0):
                 yield m.DeliverResponse(block=block).encode()
             yield m.DeliverResponse(status=m.Status.SUCCESS).encode()
-
-    @staticmethod
-    def _seek_number(pos: Optional[m.SeekPosition], support,
-                     newest_tip: bool) -> Optional[int]:
-        if pos is None:
-            return None
-        if pos.specified is not None:
-            return pos.specified.number
-        if pos.oldest is not None:
-            return 0
-        if pos.newest is not None:
-            h = support.store.height
-            return max(0, h - 1) if newest_tip else None
-        return None if not newest_tip else 0
-
 
 def make_seek_envelope(channel_id: str, start: int,
                        stop: Optional[int] = None) -> m.Envelope:

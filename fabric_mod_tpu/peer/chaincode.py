@@ -36,6 +36,17 @@ class ChaincodeStub:
         self.transient = dict(transient or {})
         # serialized creator identity (reference: shim GetCreator)
         self.creator = creator
+        # at most one event per tx (reference: shim SetEvent —
+        # handler.go overwrites on repeat calls)
+        self.event = None               # (name, payload) | None
+
+    def set_event(self, name: str, payload: bytes = b"") -> None:
+        """Attach a chaincode event to this tx's action; delivered to
+        event listeners on commit (payload stripped on the filtered
+        stream)."""
+        if not name:
+            raise ValueError("event name must be non-empty")
+        self.event = (name, payload)
 
     def creator_mspid(self) -> str:
         """MSP id of the proposal creator ('' when unavailable)."""
@@ -144,6 +155,11 @@ class KvContract:
             return val if val is not None else b""
         if op == "del":
             stub.del_state(stub.args[1].decode())
+            return b"ok"
+        if op == "putev":
+            # put + a chaincode event (drives the event deliver tests)
+            stub.put_state(stub.args[1].decode(), stub.args[2])
+            stub.set_event("kv-put", stub.args[1])
             return b"ok"
         if op == "setvp":
             # key-level endorsement override (state-based endorsement,

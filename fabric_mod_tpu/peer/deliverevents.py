@@ -1,0 +1,317 @@
+"""Client-facing event deliver service: Deliver / DeliverFiltered.
+
+(reference: core/peer/deliverevents.go — `Deliver` at :255 streaming
+full blocks, `DeliverFiltered` at :240 streaming filtered blocks;
+filtered-block construction in blockResponseSender at :293.  This is
+the service SDKs use to learn a transaction's validation code after
+commit — without it no application can know its tx committed.)
+
+Server side: seek semantics over the PEER ledger (committed blocks,
+whose metadata carries the validator's txflags), gated per-stream by
+the channel ACLs `event/Block` / `event/FilteredBlock`
+(peer/aclmgmt.py).  The stream blocks at the chain tip on the
+ledger's commit notification (KvLedger.height_changed), the analog of
+the reference's CommitNotifier.
+
+Client side: `EventDeliverClient` signs SeekInfo envelopes and exposes
+`wait_for_tx` — scan filtered blocks until a txid appears and return
+its validation code — which `chaincode invoke --wait-event` uses.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Iterator, Optional, Tuple
+
+from fabric_mod_tpu.comm.grpc_comm import GRPCClient, GRPCServer, MethodKind
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+from fabric_mod_tpu.protos.protoutil import SignedData
+
+SERVICE = "protos.Deliver"
+
+
+# ---------------------------------------------------------------------------
+# Filtered-block construction (reference: deliverevents.go:293)
+# ---------------------------------------------------------------------------
+
+def filtered_block(channel_id: str, block: m.Block) -> m.FilteredBlock:
+    """Project a committed block to its filtered form: per-tx txid,
+    header type, validation code, and chaincode events with the
+    payload NILLED (the reference strips event payloads so filtered
+    streams never leak application data)."""
+    flags = protoutil.block_txflags(block)
+    ftxs = []
+    for i, env in enumerate(protoutil.get_envelopes(block)):
+        code = (flags[i] if i < len(flags)
+                else m.TxValidationCode.NOT_VALIDATED)
+        try:
+            payload = protoutil.unmarshal_envelope_payload(env)
+            ch = m.ChannelHeader.decode(payload.header.channel_header)
+        except Exception:
+            ftxs.append(m.FilteredTransaction(tx_validation_code=code))
+            continue
+        ftx = m.FilteredTransaction(txid=ch.tx_id, type=ch.type,
+                                    tx_validation_code=code)
+        if ch.type == m.HeaderType.ENDORSER_TRANSACTION:
+            try:
+                ftx.transaction_actions = _filtered_actions(payload.data)
+            except Exception:
+                pass                   # malformed tx body: txid+code only
+        ftxs.append(ftx)
+    return m.FilteredBlock(channel_id=channel_id,
+                           number=block.header.number,
+                           filtered_transactions=ftxs)
+
+
+def _filtered_actions(tx_bytes: bytes) -> m.FilteredTransactionActions:
+    actions = []
+    tx = m.Transaction.decode(tx_bytes)
+    for action in tx.actions:
+        cap = m.ChaincodeActionPayload.decode(action.payload)
+        if cap.action is None:
+            continue
+        prp = m.ProposalResponsePayload.decode(
+            cap.action.proposal_response_payload)
+        cca = m.ChaincodeAction.decode(prp.extension)
+        event = None
+        if cca.events:
+            ev = m.ChaincodeEvent.decode(cca.events)
+            # payload stripped, per the reference's filtered contract
+            event = m.ChaincodeEvent(chaincode_id=ev.chaincode_id,
+                                     tx_id=ev.tx_id,
+                                     event_name=ev.event_name)
+        actions.append(m.FilteredChaincodeAction(chaincode_event=event))
+    return m.FilteredTransactionActions(chaincode_actions=actions)
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+class EventDeliverServer:
+    """Registers Deliver/DeliverFiltered on a gRPC server.
+
+    `acl` is a peer ACLProvider; each stream's first envelope is
+    checked against event/Block or event/FilteredBlock before any
+    block flows (reference: deliverevents.go's per-stream policy
+    check via the deliver.Handler's access control)."""
+
+    def __init__(self, channel_id: str, ledger, acl,
+                 grpc: Optional[GRPCServer] = None,
+                 address: str = "127.0.0.1:0",
+                 server_cert_pem: Optional[bytes] = None,
+                 server_key_pem: Optional[bytes] = None,
+                 client_root_pem: Optional[bytes] = None,
+                 max_streams: int = 40):
+        self._channel_id = channel_id
+        self._ledger = ledger
+        self._acl = acl
+        self._closing = threading.Event()
+        # admission cap: each BLOCK_UNTIL_READY stream parks a gRPC
+        # worker thread at the tip; without a bound, standing event
+        # subscriptions could exhaust a shared listener's pool and
+        # starve ProcessProposal (the reference bounds this with its
+        # grpc server's stream limits + deliver handler accounting)
+        self._streams = threading.Semaphore(max_streams)
+        self._owns_grpc = grpc is None
+        self._grpc = grpc or GRPCServer(address, server_cert_pem,
+                                        server_key_pem, client_root_pem)
+        self.port = self._grpc.port
+        self._grpc.register(SERVICE, "Deliver", MethodKind.STREAM_STREAM,
+                            self._make_handler(filtered=False))
+        self._grpc.register(SERVICE, "DeliverFiltered",
+                            MethodKind.STREAM_STREAM,
+                            self._make_handler(filtered=True))
+
+    def start(self) -> None:
+        if self._owns_grpc:
+            self._grpc.start()
+
+    def stop(self, grace: float = 1.0) -> None:
+        # wake every handler parked at the chain tip so shared-listener
+        # shutdown cannot strand worker threads in cond.wait
+        self._closing.set()
+        with self._ledger.height_changed:
+            self._ledger.height_changed.notify_all()
+        if self._owns_grpc:
+            self._grpc.stop(grace)
+
+    # -- stream handler --------------------------------------------------
+
+    def _make_handler(self, filtered: bool):
+        def handle(request_iter, context) -> Iterator[bytes]:
+            if not self._streams.acquire(blocking=False):
+                yield m.DeliverResponse(
+                    status=m.Status.SERVICE_UNAVAILABLE).encode()
+                return
+            try:
+                for raw in request_iter:
+                    status, seek = self._check_request(raw, filtered)
+                    if seek is None:
+                        yield m.DeliverResponse(status=status).encode()
+                        return
+                    stop_event = threading.Event()
+                    context.add_callback(stop_event.set)
+                    final = {"status": m.Status.SUCCESS}
+                    for blk in self._blocks(seek, stop_event, final):
+                        if filtered:
+                            resp = m.DeliverResponse(
+                                filtered_block=filtered_block(
+                                    self._channel_id, blk))
+                        else:
+                            resp = m.DeliverResponse(block=blk)
+                        yield resp.encode()
+                    yield m.DeliverResponse(
+                        status=final["status"]).encode()
+            finally:
+                self._streams.release()
+        return handle
+
+    def _check_request(self, raw: bytes, filtered: bool
+                       ) -> Tuple[int, Optional[m.SeekInfo]]:
+        try:
+            env = m.Envelope.decode(raw)
+            payload = protoutil.unmarshal_envelope_payload(env)
+            ch = m.ChannelHeader.decode(payload.header.channel_header)
+            sh = m.SignatureHeader.decode(payload.header.signature_header)
+            seek = m.SeekInfo.decode(payload.data)
+        except Exception:
+            return m.Status.BAD_REQUEST, None
+        if ch.channel_id != self._channel_id:
+            return m.Status.NOT_FOUND, None
+        resource = "event/FilteredBlock" if filtered else "event/Block"
+        sd = SignedData(data=env.payload, identity=sh.creator,
+                        signature=env.signature)
+        try:
+            self._acl.check_acl(resource, [sd])
+        except Exception:
+            return m.Status.FORBIDDEN, None
+        return m.Status.SUCCESS, seek
+
+    def _blocks(self, seek: m.SeekInfo, stop_event: threading.Event,
+                final: dict) -> Iterator[m.Block]:
+        """BLOCK_UNTIL_READY streams wait at the tip indefinitely —
+        the client's gRPC deadline/cancel (via `stop_event`) and
+        server close (`_closing`) are the only terminators, so long
+        event subscriptions are not silently capped (reference:
+        deliver.go's commit-notified wait).  FAIL_IF_NOT_READY at a
+        missing block sets final["status"]=NOT_FOUND — the retryable
+        error, not an empty success."""
+        led = self._ledger
+        h = led.height
+        num = protoutil.seek_number(seek.start, h, newest_tip=True) or 0
+        stop = protoutil.seek_number(seek.stop, h, newest_tip=False)
+        cond = led.height_changed
+        while stop is None or num <= stop:
+            if stop_event.is_set() or self._closing.is_set():
+                return
+            blk = led.get_block_by_number(num)
+            if blk is not None:
+                yield blk
+                num += 1
+                continue
+            if seek.behavior == m.SeekBehavior.FAIL_IF_NOT_READY:
+                final["status"] = m.Status.NOT_FOUND
+                return
+            with cond:
+                if led.height > num:
+                    continue              # raced a commit; re-read
+                # short tick: re-check cancellation/close between waits
+                cond.wait(timeout=1.0)
+        # fallthrough: [start, stop] fully served
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+def make_signed_seek_envelope(channel_id: str, start: int,
+                              stop: Optional[int], signer,
+                              behavior: Optional[int] = None
+                              ) -> m.Envelope:
+    """A SeekInfo envelope with a real creator + signature — the event
+    service enforces ACLs, so the anonymous envelope the orderer path
+    uses (orderer/server.py make_seek_envelope) is not enough."""
+    stop_pos = (m.SeekPosition(specified=m.SeekSpecified(number=stop))
+                if stop is not None else None)
+    seek = m.SeekInfo(
+        start=m.SeekPosition(specified=m.SeekSpecified(number=start)),
+        stop=stop_pos,
+        behavior=(m.SeekBehavior.BLOCK_UNTIL_READY
+                  if behavior is None else behavior))
+    ch = protoutil.make_channel_header(
+        m.HeaderType.DELIVER_SEEK_INFO, channel_id)
+    sh = protoutil.make_signature_header(signer.serialize(),
+                                         protoutil.new_nonce())
+    payload = protoutil.make_payload(ch, sh, seek.encode())
+    return protoutil.sign_envelope(payload, signer)
+
+
+class EventDeliverClient:
+    """Client over the peer event service (the SDK-shaped consumer)."""
+
+    def __init__(self, client: GRPCClient, channel_id: str, signer):
+        self._client = client
+        self._channel_id = channel_id
+        self._signer = signer
+
+    def _stream(self, method: str, start: int, stop: Optional[int],
+                timeout_s: Optional[float] = None):
+        env = make_signed_seek_envelope(self._channel_id, start, stop,
+                                        self._signer)
+        return self._client.stream_stream(SERVICE, method,
+                                          iter([env.encode()]),
+                                          timeout=timeout_s)
+
+    def blocks(self, start: int = 0, stop: Optional[int] = None,
+               timeout_s: Optional[float] = None) -> Iterator[m.Block]:
+        for raw in self._stream("Deliver", start, stop, timeout_s):
+            resp = m.DeliverResponse.decode(raw)
+            if resp.block is not None:
+                yield resp.block
+            else:
+                self._raise_unless_ok(resp.status)
+                return
+
+    def filtered_blocks(self, start: int = 0, stop: Optional[int] = None,
+                        timeout_s: Optional[float] = None
+                        ) -> Iterator[m.FilteredBlock]:
+        for raw in self._stream("DeliverFiltered", start, stop, timeout_s):
+            resp = m.DeliverResponse.decode(raw)
+            if resp.filtered_block is not None:
+                yield resp.filtered_block
+            else:
+                self._raise_unless_ok(resp.status)
+                return
+
+    @staticmethod
+    def _raise_unless_ok(status: int) -> None:
+        if status != m.Status.SUCCESS:
+            raise EventStreamError(status)
+
+    def wait_for_tx(self, txid: str, start: int = 0,
+                    timeout_s: float = 30.0) -> int:
+        """Block until `txid` appears in a committed block; return its
+        TxValidationCode.  The gRPC deadline bounds the wait (the
+        invoke flow: submit to ordering, then wait here for the
+        commit-side verdict — reference: the SDK's commit listener
+        over DeliverFiltered)."""
+        import grpc
+        try:
+            for fb in self.filtered_blocks(start=start,
+                                           timeout_s=timeout_s):
+                for ftx in fb.filtered_transactions:
+                    if ftx.txid == txid:
+                        return ftx.tx_validation_code
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+                raise TimeoutError(
+                    f"tx {txid} not committed within {timeout_s}s")
+            raise
+        raise TimeoutError(f"tx {txid} not seen before stream end")
+
+
+class EventStreamError(Exception):
+    def __init__(self, status: int):
+        super().__init__(f"event deliver stream refused: status {status}")
+        self.status = status

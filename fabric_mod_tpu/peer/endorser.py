@@ -129,8 +129,15 @@ class Endorser:
             self._channel.transient_store.persist(
                 ch.tx_id, self._channel.ledger.height, pvt)
 
+        events = b""
+        if stub.event is not None:
+            events = m.ChaincodeEvent(
+                chaincode_id=ns, tx_id=ch.tx_id,
+                event_name=stub.event[0],
+                payload=stub.event[1]).encode()
         cca = m.ChaincodeAction(
             results=rwset.encode(),
+            events=events,
             response=m.Response(status=200, payload=result),
             chaincode_id=m.ChaincodeID(name=ns))
         prp = m.ProposalResponsePayload(

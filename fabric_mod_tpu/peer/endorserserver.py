@@ -25,19 +25,26 @@ class EndorserServer:
     def __init__(self, endorser, address: str = "127.0.0.1:0",
                  server_cert_pem: Optional[bytes] = None,
                  server_key_pem: Optional[bytes] = None,
-                 client_root_pem: Optional[bytes] = None):
+                 client_root_pem: Optional[bytes] = None,
+                 grpc: Optional[GRPCServer] = None):
         self._endorser = endorser
-        self._grpc = GRPCServer(address, server_cert_pem,
-                                server_key_pem, client_root_pem)
+        # `grpc`: share one listener with the peer's other services
+        # (events, admin) the way the reference registers everything on
+        # the single peer server (internal/peer/node/start.go:205)
+        self._owns_grpc = grpc is None
+        self._grpc = grpc or GRPCServer(address, server_cert_pem,
+                                        server_key_pem, client_root_pem)
         self.port = self._grpc.port
         self._grpc.register(SERVICE, "ProcessProposal",
                             MethodKind.UNARY, self._process)
 
     def start(self) -> None:
-        self._grpc.start()
+        if self._owns_grpc:
+            self._grpc.start()
 
     def stop(self, grace: float = 1.0) -> None:
-        self._grpc.stop(grace)
+        if self._owns_grpc:
+            self._grpc.stop(grace)
 
     def _process(self, request: bytes, _context) -> bytes:
         try:

@@ -824,10 +824,66 @@ class SeekInfo(Msg):
 
 @message
 class DeliverResponse(Msg):
-    # oneof: status / block
-    FIELDS = ((1, "status", "i"), (2, "block", ("m", "Block")))
+    # oneof: status / block / filtered_block (the filtered arm is the
+    # peer event service's response, peer/events.proto DeliverResponse)
+    FIELDS = ((1, "status", "i"), (2, "block", ("m", "Block")),
+              (3, "filtered_block", ("m", "FilteredBlock")))
     status: int = 0
     block: Optional[Block] = None
+    filtered_block: Optional["FilteredBlock"] = None
+
+
+# --- peer/events.proto (client-facing event deliver service) ---------------
+# (reference: core/peer/deliverevents.go:240-310 — the filtered-block
+# shape SDKs consume to learn a tx's validation code)
+
+@message
+class ChaincodeEvent(Msg):
+    # peer/chaincode_event.proto
+    FIELDS = ((1, "chaincode_id", "s"), (2, "tx_id", "s"),
+              (3, "event_name", "s"), (4, "payload", "b"))
+    chaincode_id: str = ""
+    tx_id: str = ""
+    event_name: str = ""
+    payload: bytes = b""
+
+
+@message
+class FilteredChaincodeAction(Msg):
+    FIELDS = ((1, "chaincode_event", ("m", "ChaincodeEvent")),)
+    chaincode_event: Optional[ChaincodeEvent] = None
+
+
+@message
+class FilteredTransactionActions(Msg):
+    FIELDS = ((1, "chaincode_actions",
+               [("m", "FilteredChaincodeAction")]),)
+    chaincode_actions: List[FilteredChaincodeAction] = _f(
+        default_factory=list)
+
+
+@message
+class FilteredTransaction(Msg):
+    FIELDS = ((1, "txid", "s"), (2, "type", "i"),
+              (3, "tx_validation_code", "i"),
+              (4, "transaction_actions",
+               ("m", "FilteredTransactionActions")))
+    txid: str = ""
+    type: int = 0               # HeaderType
+    tx_validation_code: int = 0
+    transaction_actions: Optional[FilteredTransactionActions] = None
+
+
+@message
+class FilteredBlock(Msg):
+    # field 3 is skipped in peer/events.proto: filtered_transactions
+    # is 4 (SDK wire parity)
+    FIELDS = ((1, "channel_id", "s"), (2, "number", "u"),
+              (4, "filtered_transactions", [("m", "FilteredTransaction")]))
+    channel_id: str = ""
+    number: int = 0
+    filtered_transactions: List[FilteredTransaction] = _f(
+        default_factory=list)
 
 
 # --- gossip/message.proto (the epidemic layer's wire messages) -------------

@@ -278,3 +278,22 @@ def block_last_config_index(block: m.Block) -> "Optional[int]":
         return m.LastConfig.decode(meta.value).index
     except Exception:
         return None
+
+
+def seek_number(pos, height: int, newest_tip: bool):
+    """Decode one SeekPosition against a chain height — the shared
+    convention of every deliver surface (orderer AtomicBroadcast and
+    the peer event service; reference: common/deliver/deliver.go:199).
+
+    start positions (`newest_tip=True`): newest pins the current tip
+    block, absent/unknown defaults to oldest.  stop positions: newest
+    (or absent) means "no stop — stream forever"."""
+    if pos is None:
+        return None
+    if pos.specified is not None:
+        return pos.specified.number
+    if pos.oldest is not None:
+        return 0
+    if pos.newest is not None:
+        return max(0, height - 1) if newest_tip else None
+    return None if not newest_tip else 0

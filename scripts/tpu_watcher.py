@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Opportunistic on-chip bench capture (VERDICT r4 item 1).
+
+The axon TPU tunnel has been observed to hang for hours and then
+revive; the end-of-round driver capture must never be the only shot at
+a platform="tpu" number.  This watcher loops forever:
+
+  1. probe `jax.devices()` in a throwaway child with a hard timeout;
+  2. while the tunnel is dead, sleep and retry;
+  3. the moment it answers, run the full bench matrix — each variant a
+     supervised `bench.py` invocation — and persist every artifact
+     under BENCH_TPU_CAPTURE/ plus a best-of BENCH_BEST_<metric>.json
+     at the repo root (only overwritten when value improves on a real
+     tpu record).
+
+The matrix (ROUND4_NOTES "perf status" checklist):
+  a. verify, f32/MXU XLA ladder (default path)
+  b. verify, FABRIC_MOD_TPU_PALLAS=1  (Mosaic-compile the fused ladder)
+  c. verify, FABRIC_MOD_TPU_UNROLL_LOW_CARRY=1 (XLA A/B)
+  d. verify, FABRIC_MOD_TPU_PRECISION=high (vs default highest)
+  e. block / e2e / idemix / gossip metrics
+
+Each matrix entry has its own timeout so one hanging variant (Mosaic
+compile is unproven on this kernel) cannot eat the session.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUTDIR = os.path.join(REPO, "BENCH_TPU_CAPTURE")
+PROBE_TIMEOUT = float(os.environ.get("FMT_WATCH_PROBE_TIMEOUT", "150"))
+PROBE_INTERVAL = float(os.environ.get("FMT_WATCH_INTERVAL", "300"))
+RECAPTURE_INTERVAL = float(os.environ.get("FMT_WATCH_RECAPTURE", "3600"))
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def probe() -> bool:
+    """One tunnel-liveness check, reusing bench.py's probe so the
+    watcher and the bench agree on what 'alive' means."""
+    sys.path.insert(0, REPO)
+    from bench import _preflight_probe
+    platform, note = _preflight_probe(dict(os.environ), PROBE_TIMEOUT)
+    log(f"probe: {note}")
+    return platform is not None and platform != "cpu"
+
+
+# (tag, bench argv, extra env, timeout_s)
+MATRIX = [
+    ("verify_xla", ["--metric", "verify"], {}, 900),
+    ("verify_pallas", ["--metric", "verify"],
+     {"FABRIC_MOD_TPU_PALLAS": "1"}, 900),
+    ("verify_unroll", ["--metric", "verify"],
+     {"FABRIC_MOD_TPU_UNROLL_LOW_CARRY": "1"}, 900),
+    ("verify_prec_high", ["--metric", "verify"],
+     {"FABRIC_MOD_TPU_PRECISION": "high"}, 900),
+    ("block", ["--metric", "block"], {}, 1200),
+    ("e2e", ["--metric", "e2e"], {}, 1500),
+    ("idemix", ["--metric", "idemix"], {}, 1500),
+    ("gossip", ["--metric", "gossip"], {}, 900),
+]
+
+
+def run_variant(tag, argv, extra_env, timeout_s):
+    env = dict(os.environ)
+    env.update(extra_env)
+    env.setdefault("FABRIC_MOD_TPU_JIT_CACHE",
+                   os.path.expanduser("~/.cache/fabric_mod_tpu/jit"))
+    # the watcher already probed; don't respend probe budget per variant
+    env["FABRIC_MOD_TPU_BENCH_PROBE_TIMEOUT"] = "120"
+    env["FABRIC_MOD_TPU_BENCH_TIMEOUT"] = str(int(timeout_s - 60))
+    env["FABRIC_MOD_TPU_BENCH_ATTEMPTS"] = "1"
+    cmd = [sys.executable, os.path.join(REPO, "bench.py")] + argv
+    log(f"run {tag}: {' '.join(argv)} env={extra_env}")
+    t0 = time.time()
+    logpath = os.path.join(OUTDIR, f"{tag}.log")
+    try:
+        with open(logpath, "ab") as lf:
+            proc = subprocess.run(cmd, env=env, timeout=timeout_s,
+                                  stdout=subprocess.PIPE, stderr=lf)
+    except subprocess.TimeoutExpired:
+        log(f"{tag}: TIMED OUT after {timeout_s}s")
+        return None
+    dt = time.time() - t0
+    for line in reversed(proc.stdout.decode().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            rec["capture_tag"] = tag
+            rec["capture_time"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+            rec["capture_wall_s"] = round(dt, 1)
+            log(f"{tag}: {json.dumps(rec)}")
+            return rec
+    log(f"{tag}: rc={proc.returncode}, no JSON after {dt:.0f}s")
+    return None
+
+
+def persist(rec):
+    os.makedirs(OUTDIR, exist_ok=True)
+    tag = rec["capture_tag"]
+    stamp = time.strftime("%H%M%S")
+    with open(os.path.join(OUTDIR, f"{tag}_{stamp}.json"), "w") as f:
+        json.dump(rec, f, indent=1)
+    if rec.get("platform") != "tpu":
+        return
+    # best-of per metric at repo root, tpu-only
+    best_path = os.path.join(REPO, f"BENCH_BEST_{rec['metric']}.json")
+    try:
+        with open(best_path) as f:
+            best = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        best = None
+    if best is None or rec.get("value", 0) > best.get("value", 0):
+        with open(best_path, "w") as f:
+            json.dump(rec, f, indent=1)
+        log(f"new best for {rec['metric']}: {rec['value']} ({tag})")
+
+
+def capture_matrix():
+    got_tpu = False
+    for tag, argv, env, timeout_s in MATRIX:
+        rec = run_variant(tag, argv, env, timeout_s)
+        if rec is not None:
+            persist(rec)
+            if rec.get("platform") == "tpu":
+                got_tpu = True
+        # quick re-probe between variants: if the tunnel died mid-
+        # matrix, stop burning per-variant timeouts
+        if rec is None and not probe():
+            log("tunnel died mid-matrix; back to waiting")
+            return got_tpu
+    return got_tpu
+
+
+def main():
+    os.makedirs(OUTDIR, exist_ok=True)
+    log(f"watcher up; probe every {PROBE_INTERVAL}s, "
+        f"timeout {PROBE_TIMEOUT}s")
+    last_full = 0.0
+    while True:
+        if probe():
+            if time.time() - last_full >= RECAPTURE_INTERVAL:
+                ok = capture_matrix()
+                if ok:
+                    last_full = time.time()
+                    log("matrix captured on tpu; next recapture in "
+                        f"{RECAPTURE_INTERVAL}s")
+            else:
+                log("tpu alive; matrix already captured recently")
+        time.sleep(PROBE_INTERVAL)
+
+
+if __name__ == "__main__":
+    main()

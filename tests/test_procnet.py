@@ -354,6 +354,20 @@ def test_chaincode_cli_invoke_and_query_across_processes(procnet):
     assert _wait(lambda: all((net.peer_height(p) or 0) >= 2
                              for p in ("p0", "p1")), t=60)
 
+    # invoke --wait-event: the client learns its tx's validation code
+    # from the peer's DeliverFiltered event stream (reference:
+    # deliverevents.go:240 + `peer chaincode invoke --waitForEvent`)
+    rc = chaincode_main([
+        "invoke", "--channel", "procchan", "--name", "mycc",
+        "--args", "put,evkey,evvalue", "--wait-event",
+        "--wait-timeout", "60",
+        "--crypto", net.crypto_dir, "--org", "Org1", "--user", "user0",
+        "--peers", peers,
+        "--orderer", f"127.0.0.1:{net.bports['o0']}",
+        "--tls-ca", os.path.join(net.root, "tls", "peer", "ca.crt"),
+    ])
+    assert rc == 0                     # 0 == committed VALID
+
     import io
     import contextlib
     for p in ("p0", "p1"):
