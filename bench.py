@@ -163,7 +163,9 @@ def measure_block(n_txs: int, reps: int) -> tuple:
 def measure_e2e(n_txs: int) -> tuple:
     """End-to-end validated tx/s: endorsed txs -> solo orderer cuts
     blocks -> peer verifies (device batch) + MVCC + commits
-    (BASELINE config #3 shape, in-process network)."""
+    (BASELINE config #3 shape, in-process network).  Returns the
+    pipeline stage split too, so the record shows whether throughput
+    is bounded by ordering or by crypto (BASELINE's e2e criterion)."""
     from fabric_mod_tpu.bccsp.sw import SwCSP
     from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier, TpuVerifier
     from fabric_mod_tpu.e2e import run_pipeline
@@ -172,9 +174,10 @@ def measure_e2e(n_txs: int) -> tuple:
     log(f"sw e2e: {sw_rate:,.0f} tx/s")
     verifier = TpuVerifier()
     run_pipeline(min(n_txs, 2000), verifier)      # warm-up/compile
-    dev_rate = run_pipeline(n_txs, verifier)
-    log(f"device e2e: {dev_rate:,.0f} tx/s")
-    return dev_rate, sw_rate
+    stats = {}
+    dev_rate = run_pipeline(n_txs, verifier, stats=stats)
+    log(f"device e2e: {dev_rate:,.0f} tx/s  split: {stats}")
+    return dev_rate, sw_rate, stats
 
 
 def measure_idemix(n: int, reps: int) -> tuple:
@@ -353,12 +356,13 @@ def run_worker(args) -> int:
         # the batch IS the tx count (the supervisor's CPU-fallback
         # bound must be respected; the consenter's batch timeout cuts
         # partial blocks, so small counts still flow)
-        dev_rate, sw_rate = measure_e2e(args.batch)
+        dev_rate, sw_rate, stats = measure_e2e(args.batch)
         out = {
             "metric": "e2e_validated_tx_per_sec",
             "value": round(dev_rate, 1),
             "unit": "tx/s",
             "vs_baseline": round(dev_rate / sw_rate, 3),
+            "pipeline_split": stats,
         }
     else:
         items, expect = make_items(args.batch)

@@ -99,14 +99,23 @@ class TpuVerifier:
                     f"bucket {BUCKETS[-1]} (use a power-of-two mesh)")
 
     def verify_many(self, items: Sequence[VerifyItem]) -> np.ndarray:
+        return self.verify_many_async(items)()
+
+    def verify_many_async(self, items: Sequence[VerifyItem]):
+        """Marshal + DISPATCH the device batch, returning a zero-arg
+        resolver for the verdicts.  Between dispatch and resolution the
+        device executes while the caller does host work for the next
+        block — the commit pipeline's double buffer (SURVEY §2.9
+        row 2; reference analog: the payload buffer decoupling pull
+        from commit at gossip/state/state.go:583)."""
         n = len(items)
         if n == 0:
-            return np.zeros(0, bool)
+            return lambda: np.zeros(0, bool)
         if n > BUCKETS[-1]:
             # chunk through the fixed buckets — never mint new shapes
-            return np.concatenate([
-                self.verify_many(items[i:i + BUCKETS[-1]])
-                for i in range(0, n, BUCKETS[-1])])
+            parts = [self.verify_many_async(items[i:i + BUCKETS[-1]])
+                     for i in range(0, n, BUCKETS[-1])]
+            return lambda: np.concatenate([p() for p in parts])
         size = _bucket(n, self._mesh_size)
         d = np.zeros((size, 32), np.uint8)
         r = np.zeros((size, 32), np.uint8)
@@ -130,8 +139,9 @@ class TpuVerifier:
             except Exception:
                 continue
         from fabric_mod_tpu.ops import p256
-        mask = p256.batch_verify(d, r, s, qx, qy, mesh=self._mesh)
-        return (mask & pre_ok)[:n]
+        resolve = p256.batch_verify(d, r, s, qx, qy, mesh=self._mesh,
+                                    lazy=True)
+        return lambda: (resolve() & pre_ok)[:n]
 
 
 class FakeBatchVerifier:
@@ -144,6 +154,12 @@ class FakeBatchVerifier:
 
     def verify_many(self, items: Sequence[VerifyItem]) -> np.ndarray:
         return np.asarray(self._csp.verify_batch(items), bool)
+
+    def verify_many_async(self, items: Sequence[VerifyItem]):
+        """Deferred-to-resolution stand-in for the device's async
+        dispatch: the sw verify runs when the resolver is called (in
+        the commit stage), preserving the pipeline's thread layout."""
+        return lambda: self.verify_many(items)
 
 
 class BatchingVerifyService:

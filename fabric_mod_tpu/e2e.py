@@ -204,10 +204,16 @@ class Network:
             self.ingress_service.close()
 
 
-def run_pipeline(n_txs: int, verifier, reps_unused: int = 1) -> float:
+def run_pipeline(n_txs: int, verifier, reps_unused: int = 1,
+                 stats: dict = None) -> float:
     """Endorse n_txs txs, broadcast them, commit them through the full
     peer pipeline; return committed tx/s over the ordering+commit span
-    (endorsement/signing excluded — it is client work)."""
+    (endorsement/signing excluded — it is client work).
+
+    `stats`, if given, receives the pipeline's stage wall times
+    (stage_secs = host unpack + device dispatch, commit_secs = verdict
+    resolve + MVCC + ledger commit, wall_secs = the measured span) so
+    the bench can show how much verify time the double buffer hides."""
     with tempfile.TemporaryDirectory() as root:
         net = Network(root, verifier=verifier)
         try:
@@ -251,6 +257,10 @@ def run_pipeline(n_txs: int, verifier, reps_unused: int = 1) -> float:
             if committed < n_txs:
                 raise RuntimeError(
                     f"only {committed}/{n_txs} txs committed")
+            if stats is not None:
+                stats["stage_secs"] = round(client.stage_secs, 3)
+                stats["commit_secs"] = round(client.commit_secs, 3)
+                stats["wall_secs"] = round(dt, 3)
             return n_txs / dt
         finally:
             net.close()

@@ -335,6 +335,15 @@ class GRPCGossipNetwork:
             claimed_tls = self._unb64(d["tls"])
             sig = self._unb64(d["sig"])
             actual_tls = self._peer_cert_hash(context)
+            if not actual_tls:
+                # no mTLS client cert on this connection: both hashes
+                # would be b"" and the "binding" check below would pass
+                # vacuously, turning session tokens into unbound bearer
+                # credentials.  Auth-enabled gossip requires mTLS —
+                # fail the handshake instead of degrading silently.
+                return self._json.dumps(
+                    {"error": "auth requires an mTLS client "
+                     "certificate to bind the session to"}).encode()
             if claimed_tls != actual_tls:
                 # the signed TLS binding does not match the cert on
                 # THIS connection: a replayed/stolen handshake

@@ -50,6 +50,14 @@ class TTLMessageStore:
                     return False
             while self._count >= self._max and len(self._buckets) > 1:
                 self._count -= len(self._buckets.popleft()[1])
+            if self._count >= self._max:
+                # a single-bucket burst (everything arrived within one
+                # bucket width) has nothing older to evict: refuse the
+                # insert so the flood bound actually holds.  "Seen"
+                # (False) is the safe answer — the store exists to
+                # suppress re-forwarding, and a flooding burst is
+                # exactly when re-forwarding must stop.
+                return False
             if self._buckets and self._buckets[-1][0] == idx:
                 self._buckets[-1][1].add(key)
             else:

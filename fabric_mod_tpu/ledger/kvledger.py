@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from fabric_mod_tpu.utils.racecheck import OrderedLock
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from fabric_mod_tpu.ledger.blkstorage import BlockStore
@@ -228,7 +230,11 @@ class KvLedger:
         self.dir = ledger_dir
         self._durable = durable
         os.makedirs(ledger_dir, exist_ok=True)
-        self._lock = threading.RLock()
+        # rank 10 in the lock hierarchy (utils/racecheck.py): the
+        # commit path nests transient (20) / pvt (30) store locks
+        # inside this one; an inversion anywhere raises instead of
+        # deadlocking (the -race analog, SURVEY Â§5.2)
+        self._lock = OrderedLock(10, "kvledger")
         # commit notification for event deliver streams (reference:
         # the ledger's CommitNotifier consumed by deliverevents.go)
         self.height_changed = threading.Condition()

@@ -20,6 +20,8 @@ import hashlib
 import json
 import os
 import threading
+
+from fabric_mod_tpu.utils.racecheck import OrderedLock
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from fabric_mod_tpu.protos import messages as m
@@ -144,7 +146,7 @@ class TransientStore:
         plaintext survives a peer restart (reference: the leveldb
         transientstore) — without it, endorsement-time staging is lost
         on crash and must be re-reconciled from peers."""
-        self._lock = threading.Lock()
+        self._lock = OrderedLock(20, "transientstore")
         self._max = max_entries
         self._count = 0
         # txid -> [(received_at_block, TxPvtReadWriteSet bytes)]
@@ -250,7 +252,7 @@ class PvtDataStore:
         survive a peer restart (reference: the leveldb-backed
         pvtdatastorage/store.go); without it the plaintext must be
         re-reconciled from peers after a crash."""
-        self._lock = threading.Lock()
+        self._lock = OrderedLock(30, "pvtdatastore")
         # (block, tx) -> [(ns, collection, KVRWSet bytes)]
         self._by_block: Dict[Tuple[int, int],
                              List[Tuple[str, str, bytes]]] = {}

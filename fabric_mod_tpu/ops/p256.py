@@ -404,12 +404,15 @@ def marshal_inputs(digests: np.ndarray, r_bytes: np.ndarray,
 
 def batch_verify(digests: np.ndarray, r_bytes: np.ndarray,
                  s_bytes: np.ndarray, qx_bytes: np.ndarray,
-                 qy_bytes: np.ndarray, mesh=None) -> np.ndarray:
+                 qy_bytes: np.ndarray, mesh=None, lazy: bool = False):
     """Verify a batch of ECDSA-P256 signatures over 32-byte digests.
 
-    All args are (batch, 32) uint8 big-endian.  Returns (batch,) bool.
-    Host does only range checks + byte->limb marshalling; all field math
-    runs in one jitted device program.
+    All args are (batch, 32) uint8 big-endian.  Returns (batch,) bool —
+    or, with `lazy=True`, a zero-arg resolver: the device program has
+    been DISPATCHED (jax dispatch is asynchronous) but not awaited, so
+    the caller can overlap host work for the next batch against this
+    one's device execution and call the resolver when the verdicts are
+    needed (the commit pipeline's double buffer, SURVEY §2.9 row 2).
 
     `mesh` (optional jax.sharding.Mesh, see parallel/mesh.py) shards
     the trailing batch axis of the limb arrays across the `dp` axis, so
@@ -446,6 +449,8 @@ def batch_verify(digests: np.ndarray, r_bytes: np.ndarray,
         # multiples of 8) — a lane width under 8 would make the grid
         # pathological, so stay on the XLA core
     ok = core(*(_dev(a, s) for a, s in zip(core_args, shardings)))
+    if lazy:
+        return lambda: np.asarray(ok) & range_ok
     return np.asarray(ok) & range_ok
 
 

@@ -313,7 +313,8 @@ class RaftNode:
                  rng: Optional[random.Random] = None,
                  snapshot_interval: Optional[int] = None,
                  snapshot_cb: Optional[Callable[[], bytes]] = None,
-                 install_cb: Optional[Callable[[int, bytes], None]] = None):
+                 install_cb: Optional[Callable[[int, bytes], None]] = None,
+                 clock=None):
         self.id = node_id
         self.peers = [p for p in peers if p != node_id]
         self._transport = transport
@@ -346,6 +347,24 @@ class RaftNode:
         self._q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._deadline = 0.0
+        # pluggable time source: election/heartbeat deadlines are
+        # compared against self._now(), so a ManualClock (utils/
+        # fakeclock.py) makes timer behavior deterministic — the
+        # kill-harness tests stop depending on wall-clock under CPU
+        # load.  A subscribable clock wakes the FSM on advance so the
+        # queue wait re-evaluates the (fake) deadline.
+        if clock is None:
+            self._now = time.monotonic
+        else:
+            self._now = clock.monotonic
+            subscribe = getattr(clock, "subscribe", None)
+            if subscribe is not None:
+                subscribe(lambda: self._q.put(("noop",)))
+        # machine-checked single-threaded-FSM contract (the -race
+        # analog, utils/racecheck.py): every state transition must run
+        # on the FSM thread — a stray cross-thread call raises
+        from fabric_mod_tpu.utils.racecheck import ThreadOwnership
+        self._fsm_owner = ThreadOwnership(f"raft-fsm[{node_id}]")
         self._thread = threading.Thread(target=self._run, daemon=True)
         transport.register(node_id, lambda src, msg:
                            self._q.put(("msg", src, msg)))
@@ -388,12 +407,18 @@ class RaftNode:
 
     # -- FSM loop (reference: chain.go:533 run) ---------------------------
     def _run(self) -> None:
+        self._fsm_owner.claim()
         while not self._stop.is_set():
-            timeout = max(0.0, self._deadline - time.monotonic())
+            timeout = max(0.0, self._deadline - self._now())
             try:
                 item = self._q.get(timeout=timeout)
             except queue.Empty:
-                self._on_timer()
+                # the blocking wait above is REAL time; the deadline is
+                # clock time.  Under a manual clock they diverge, so a
+                # real-time expiry only fires the timer if clock time
+                # agrees (frozen clock => frozen timers, by design)
+                if self._now() >= self._deadline:
+                    self._on_timer()
                 continue
             kind = item[0]
             if kind == "msg":
@@ -402,8 +427,14 @@ class RaftNode:
                 self._on_propose(item[1])
             elif kind == "reconfig":
                 self._on_reconfig(item[1])
+            # manual clocks block the queue wait in REAL time while
+            # deadlines live in FAKE time: re-check expiry on every
+            # wakeup (noop items from clock.advance land here)
+            if self._now() >= self._deadline and not self._stop.is_set():
+                self._on_timer()
 
     def _on_reconfig(self, node_ids) -> None:
+        self._fsm_owner.guard()
         self.member = self.id in node_ids
         self.peers = [p for p in node_ids if p != self.id]
         for gone in [p for p in self._next_index
@@ -417,13 +448,14 @@ class RaftNode:
             self._step_down(self._wal.term)
 
     def _reset_election_timer(self) -> None:
-        self._deadline = (time.monotonic()
+        self._deadline = (self._now()
                           + self._rng.uniform(*self._eto))
 
     def _on_timer(self) -> None:
+        self._fsm_owner.guard()
         if self.state == LEADER:
             self._broadcast_append()
-            self._deadline = time.monotonic() + self._hb
+            self._deadline = self._now() + self._hb
         elif self.member:
             self._start_election()
         else:
@@ -455,7 +487,7 @@ class RaftNode:
             self._append_local(b"")
             self._advance_commit()         # single-node quorum
             self._broadcast_append()
-            self._deadline = time.monotonic() + self._hb
+            self._deadline = self._now() + self._hb
 
     def _step_down(self, term: int) -> None:
         if term > self._wal.term:
@@ -475,6 +507,7 @@ class RaftNode:
         return idx
 
     def _on_propose(self, data: bytes) -> None:
+        self._fsm_owner.guard()
         if self.state != LEADER:
             return
         self._append_local(data)
@@ -494,7 +527,7 @@ class RaftNode:
             # snapshot instead (reference: chain.go:880 catchUp).
             # Installation triggers an app-level block fetch, so do
             # not hammer a slow installer on every heartbeat.
-            now = time.monotonic()
+            now = self._now()
             if now - self._snap_sent.get(peer, 0.0) >= 10 * self._hb:
                 self._snap_sent[peer] = now
                 self._transport.send(self.id, peer, InstallSnapshot(
@@ -514,6 +547,7 @@ class RaftNode:
 
     # -- message handling --------------------------------------------------
     def _on_message(self, src: str, msg) -> None:
+        self._fsm_owner.guard()
         if isinstance(msg, RequestVote):
             self._on_request_vote(msg)
         elif isinstance(msg, VoteReply):

@@ -163,8 +163,12 @@ class FailoverDeliverSource:
                     watchdog.abandon()
                     stream.cancel()
             except grpc.RpcError as e:
-                log.info("deliver stream to %s failed: %s", ep.address,
-                         getattr(e, "code", lambda: e)())
+                # repr, not e.code(): an RpcError without a bound
+                # code() would make the log call itself raise inside
+                # the except block and kill the deliver thread this
+                # handler exists to protect
+                log.info("deliver stream to %s failed: %r",
+                         ep.address, e)
             except Exception as e:
                 # anything else a bad orderer can induce (garbage
                 # frames failing DeliverResponse.decode, ...) must

@@ -181,6 +181,20 @@ class Channel:
         flags = self.validator().validate(block)
         return self.ledger.commit_block(block, flags)
 
+    # pipelined split: stage (host unpack + async device dispatch) may
+    # run ahead of the previous block's commit; commit_staged resolves
+    # the verdicts and commits.  `staged.needs_barrier` tells the
+    # pipeline when staging must NOT run ahead (config / vp-write /
+    # lifecycle blocks).
+    def stage_block(self, block: m.Block):
+        return self.validator().stage(block)
+
+    def commit_staged(self, staged) -> List[int]:
+        # finish on the validator that staged: its pending evaluators
+        # hold that validator's batch slots
+        flags = staged.validator.finish(staged)
+        return self.ledger.commit_block(staged.block, flags)
+
     def committer(self) -> Committer:
         return _ChannelCommitter(self)
 

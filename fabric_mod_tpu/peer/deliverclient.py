@@ -5,17 +5,26 @@
 gossip/state/state.go:583's `deliverPayloads` commit loop through the
 in-order payload buffer.)
 
-Two pipeline stages, exactly the overlap SURVEY §2.9 row 2 calls for:
+Three pipeline stages — the double buffer of SURVEY §2.9 row 2:
 
-  stage 1 (this thread / `run`):   pull block N+1, hash-check + verify
-                                   its orderer signature (device batch)
-  stage 2 (commit worker thread):  validate + MVCC + commit block N
+  stage 1 (this thread / `run`):   pull block N+2, hash-check + verify
+                                   its orderer signature
+  stage 2 (stage worker thread):   host unpack + policy staging of
+                                   block N+1, then DISPATCH its device
+                                   verify batch without awaiting it
+  stage 3 (commit worker thread):  await block N's device verdicts,
+                                   resolve flags, MVCC + commit
 
-A bounded in-order queue between them is the payload buffer; commit
-order is the block-number order by construction (single puller).  The
-same two-stage split also overlaps block N+1's envelope unpack (pass 1
-of the validator runs in stage 2, but its device dispatch overlaps
-stage 1's next pull on the host side).
+Block N+1's host unmarshalling overlaps block N's device execution:
+the device batch is in flight between stage 2's dispatch and stage
+3's resolve.  Bounded in-order queues between stages are the payload
+buffer; commit order is block-number order by construction (single
+puller).  Staging must not run ahead of a block that changes what
+staging reads — config txs, VALIDATION_PARAMETER writes, lifecycle
+definitions — so such blocks set `needs_barrier` and stage 2 waits
+for their commit before staging the next block (the reference's
+serialization points: validator.go:400 config, validator_keylevel.go
+waits).
 """
 from __future__ import annotations
 
@@ -47,28 +56,75 @@ class DeliverClient:
         self._channel = channel
         self._source = source
         self._q: "queue.Queue[Optional[m.Block]]" = queue.Queue(queue_size)
+        # staged (dispatched, unresolved) blocks; small: each entry
+        # holds a device batch in flight — 2 is the double buffer
+        self._staged_q: "queue.Queue" = queue.Queue(2)
         self._stop = threading.Event()
         self._on_error = on_error
         self._on_commit = on_commit
         self.rejected: List[int] = []      # block numbers that failed MCS
+        # cumulative wall seconds per stage (the e2e bench reports
+        # these to show the verify-vs-commit overlap)
+        self.stage_secs = 0.0
+        self.commit_secs = 0.0
         self._commit_err: Optional[Exception] = None
         self._committed = threading.Condition()
         self._height = channel.ledger.height
 
-    # -- stage 2: the commit worker --------------------------------------
+    def _fail(self, e: Exception) -> None:
+        self._commit_err = e
+        self._stop.set()
+        if self._on_error is not None:
+            self._on_error(e)
+
+    # -- stage 2: host unpack + device dispatch --------------------------
+    def _stage_loop(self) -> None:
+        import time as _time
+        try:
+            while True:
+                block = self._q.get()
+                if block is None:
+                    return
+                t0 = _time.perf_counter()
+                staged = self._channel.stage_block(block)
+                self.stage_secs += _time.perf_counter() - t0
+                barrier = staged.needs_barrier
+                self._staged_q.put(staged)
+                if barrier:
+                    # this block changes state that staging reads:
+                    # wait for its commit before staging the next one
+                    want = block.header.number + 1
+                    with self._committed:
+                        while (self._height < want
+                               and not self._stop.is_set()
+                               and self._commit_err is None):
+                            self._committed.wait(timeout=0.5)
+        except Exception as e:
+            self._fail(e)
+            # keep draining so the puller's bounded put never deadlocks
+            while self._q.get() is not None:
+                pass
+        finally:
+            self._staged_q.put(None)
+
+    # -- stage 3: the commit worker --------------------------------------
     def _commit_loop(self) -> None:
+        import time as _time
         while True:
-            block = self._q.get()
-            if block is None:
+            staged = self._staged_q.get()
+            if staged is None:
                 return
             try:
-                self._channel.store_block(block)
+                t0 = _time.perf_counter()
+                self._channel.commit_staged(staged)
+                self.commit_secs += _time.perf_counter() - t0
             except Exception as e:
-                self._commit_err = e
-                self._stop.set()
-                if self._on_error is not None:
-                    self._on_error(e)
+                self._fail(e)
+                # drain so the stage worker's bounded put never blocks
+                while self._staged_q.get() is not None:
+                    pass
                 return
+            block = staged.block
             with self._committed:
                 self._height = block.header.number + 1
                 self._committed.notify_all()
@@ -89,6 +145,8 @@ class DeliverClient:
         if start > 0:
             prev = self._channel.ledger.get_block_by_number(start - 1)
             prev_hash = protoutil.block_header_hash(prev.header)
+        stager = threading.Thread(target=self._stage_loop, daemon=True)
+        stager.start()
         worker = threading.Thread(target=self._commit_loop, daemon=True)
         worker.start()
         try:
@@ -120,6 +178,7 @@ class DeliverClient:
                 self._q.put(block)
         finally:
             self._q.put(None)
+            stager.join()
             worker.join()
         if self._commit_err is not None:
             raise self._commit_err

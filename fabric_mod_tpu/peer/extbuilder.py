@@ -423,21 +423,22 @@ class ChaincodeLauncher:
     on first use (reference: chaincode_support.go:93 Launch).  Wire it
     as the ChaincodeRegistry's resolver.
 
-    Package types:
-    * "ccaas": code payload is connection.json — dial the running
-      chaincode server (no process management; reference ccaas).
-    * "python": code payload is a module source defining `contract`
-      (or a callable `invoke`) — exec'd in-process, the runtime's
-      native unit (ccpackage.py's documented distribution unit).
-    * anything else: offered to the external builders.
+    Package types route through the language platforms registry
+    (peer/platforms.py — python in-proc, ccaas dial-out, script
+    launch; reference: core/chaincode/platforms/platforms.go:62);
+    types no platform claims are offered to the external builders.
     """
 
-    def __init__(self, package_store, builders=None):
+    def __init__(self, package_store, builders=None, platforms=None):
+        from fabric_mod_tpu.peer.platforms import (LaunchContext,
+                                                   PlatformRegistry)
         self._store = package_store
         self._builders = builders or ExternalBuilderRegistry()
+        self._platforms = platforms or PlatformRegistry()
         self._live: Dict[str, object] = {}
         self._procs: List[subprocess.Popen] = []
         self._lock = threading.Lock()
+        self._launch_ctx = LaunchContext(self._procs.append)
 
     def resolve(self, name: str):
         with self._lock:
@@ -469,23 +470,11 @@ class ChaincodeLauncher:
         if got is None:
             return None
         label, cc_type, code = got
-        if cc_type == "ccaas":
-            try:
-                conn = json.loads(code)
-            except Exception as e:
-                raise ExternalBuilderError(
-                    f"package {label}: bad connection.json: {e}") from e
-            return ExternalContract(conn)
-        if cc_type == "python":
-            ns: Dict = {}
-            exec(compile(code, f"<chaincode {label}>", "exec"), ns)
-            contract = ns.get("contract")
-            if contract is None and callable(ns.get("invoke")):
-                from fabric_mod_tpu.peer.chaincode import FuncContract
-                contract = FuncContract(ns["invoke"])
-            if contract is None:
-                raise ExternalBuilderError(
-                    f"package {label}: defines no `contract`")
+        # language platforms first (platforms.go:198 dispatch), then
+        # the external-builder fallback for unclaimed types
+        contract = self._platforms.build_for(label, cc_type, code,
+                                             self._launch_ctx)
+        if contract is not None:
             return contract
         return self._build_external(label, cc_type, code)
 

@@ -138,7 +138,24 @@ class LifecycleContract:
             mspid = stub.creator_mspid()
             if not mspid:
                 raise ChaincodeError("approve: no creator identity")
-            self._check_sequence(stub, name, sequence)
+            # a late org may approve the CURRENTLY COMMITTED sequence
+            # to catch up (reference: ApproveChaincodeDefinitionForMyOrg
+            # accepts currentSequence when the parameters match the
+            # committed definition); anything else must be committed+1
+            prev = stub.get_state(definition_key(name))
+            prev_seq = (m.ChaincodeDefinition.decode(prev).sequence
+                        if prev else 0)
+            if prev and sequence == prev_seq:
+                d = m.ChaincodeDefinition.decode(prev)
+                if (d.version != version
+                        or d.endorsement_policy != policy
+                        or d.validation_plugin != plugin
+                        or d.collections != collections):
+                    raise ChaincodeError(
+                        f"approve for committed sequence {sequence} "
+                        f"must match the committed definition")
+            else:
+                self._check_sequence(stub, name, sequence)
             stub.put_state(
                 approval_key(name, sequence, mspid),
                 _param_digest(version, sequence, policy, collections,
@@ -173,6 +190,12 @@ class LifecycleContract:
                 yes = sum(ready.values())
                 # MAJORITY of application orgs (the channel default
                 # LifecycleEndorsement rule)
+                if not ready:
+                    # zero orgs: need would be 1-of-0, unsatisfiable —
+                    # fail with the real cause instead
+                    raise ChaincodeError(
+                        "commit: channel has no application orgs to "
+                        "approve definitions")
                 need = len(ready) // 2 + 1
                 if yes < need:
                     raise ChaincodeError(

@@ -91,7 +91,7 @@ class _TxWork:
     """Per-tx staging between the host pass and the device verdict."""
 
     __slots__ = ("flag", "txid", "creator_slot", "actions", "is_config",
-                 "env", "vp_writes")
+                 "env", "vp_writes", "written_ns")
 
     def __init__(self):
         self.flag = V.NOT_VALIDATED
@@ -101,6 +101,35 @@ class _TxWork:
         self.is_config = False
         self.env = None                   # kept only for config txs
         self.vp_writes = []               # [(ns, key, policy_bytes)]
+        self.written_ns = set()           # namespaces this tx writes
+
+
+class StagedBlock:
+    """A block after passes 1+2: host staging done, device batch
+    dispatched, verdicts pending (resolved by TxValidator.finish)."""
+
+    __slots__ = ("block", "validator", "works", "mask_fn")
+
+    def __init__(self, block, validator, works, mask_fn):
+        self.block = block
+        self.validator = validator
+        self.works = works
+        self.mask_fn = mask_fn
+
+    @property
+    def needs_barrier(self) -> bool:
+        """True when the NEXT block's staging must wait for this
+        block's commit: config txs swap the bundle/MSPs, VALIDATION_
+        PARAMETER writes change key-level policies, and lifecycle-
+        namespace writes change validation info — all state that
+        pass 1 reads (reference: the key-level validator's wait at
+        validator_keylevel.go + the config serialization in
+        validator.go:400)."""
+        from fabric_mod_tpu.peer.lifecycle import LIFECYCLE_NS
+        for w in self.works:
+            if w.is_config or w.vp_writes or LIFECYCLE_NS in w.written_ns:
+                return True
+        return False
 
 
 class TxValidator:
@@ -191,9 +220,10 @@ class TxValidator:
         if ch.tx_id != expected:
             work.flag = V.BAD_PROPOSAL_TXID
             return
-        if self._tx_id_exists(ch.tx_id):
-            work.flag = V.DUPLICATE_TXID
-            return
+        # NOTE: the committed-store duplicate-txid check runs in pass 3
+        # (_finish_tx callers), not here — staging may run ahead of the
+        # previous block's commit in the pipelined path, and only at
+        # finish time is the committed store guaranteed current.
 
         # endorsement policy per action (reference: VSCC v20
         # validation_logic.go:185 + validator_keylevel.go:245-258:
@@ -265,6 +295,8 @@ class TxValidator:
             return key_evals
         from fabric_mod_tpu.ledger.rwsetutil import parse_tx_rwset
         for ns, kv in parse_tx_rwset(rwset):
+            if kv.writes or kv.metadata_writes:
+                work.written_ns.add(ns)
             written = dict.fromkeys(
                 [w.key for w in kv.writes]
                 + [mw.key for mw in kv.metadata_writes])
@@ -296,10 +328,13 @@ class TxValidator:
         return key_evals
 
     # -- the three passes -------------------------------------------------
-    def validate(self, block: m.Block) -> List[int]:
-        """Validate every tx of `block`; ONE device dispatch total.
-        Writes the txflags bitmap into the block metadata and returns
-        the flags (reference: validator.go:182-267)."""
+    def stage(self, block: m.Block) -> "StagedBlock":
+        """Passes 1+2: host unpack/staging, then DISPATCH the device
+        batch without awaiting it.  The returned StagedBlock carries
+        the pending verdicts; `finish` resolves them.  Staging block
+        N+1 while block N commits is the commit pipeline's double
+        buffer — legal exactly when block N sets no state the staging
+        reads (see StagedBlock.needs_barrier)."""
         works: List[_TxWork] = []
         collector = BatchCollector()
         # (ns, key) -> [(tx_idx, ApplicationPolicy bytes)]: the
@@ -318,19 +353,31 @@ class TxValidator:
             for ns, key, vp in work.vp_writes:
                 inblock_vp.setdefault((ns, key), []).append((idx, vp))
 
-        # pass 2: the device batch
-        mask = self._verifier.verify_many(collector.items)
+        # pass 2: dispatch the device batch (async when the verifier
+        # supports it; the resolver blocks only when called)
+        async_fn = getattr(self._verifier, "verify_many_async", None)
+        if async_fn is not None:
+            mask_fn = async_fn(collector.items)
+        else:
+            items = collector.items
+            mask_fn = lambda: self._verifier.verify_many(items)
+        return StagedBlock(block, self, works, mask_fn)
 
-        # pass 3: sequential verdicts — duplicate marking and key-level
-        # override application happen in block order so later txs see
-        # exactly the effects of earlier VALID ones
+    def finish(self, staged: "StagedBlock") -> List[int]:
+        """Pass 3: await the device verdicts, then sequential flag
+        resolution — duplicate marking and key-level override
+        application happen in block order so later txs see exactly the
+        effects of earlier VALID ones."""
+        block, works = staged.block, staged.works
+        mask = staged.mask_fn()
         flags: List[int] = []
         seen_txids = set()
         applied_vp: Dict[tuple, int] = {}   # (ns, key) -> writer tx_idx
         for idx, work in enumerate(works):
             flag = self._finish_tx(work, mask, applied_vp)
             if flag == V.VALID and work.txid:
-                if work.txid in seen_txids:
+                if work.txid in seen_txids or \
+                        self._tx_id_exists(work.txid):
                     flag = V.DUPLICATE_TXID
                 else:
                     seen_txids.add(work.txid)
@@ -340,6 +387,12 @@ class TxValidator:
             flags.append(flag)
         protoutil.set_block_txflags(block, bytes(flags))
         return flags
+
+    def validate(self, block: m.Block) -> List[int]:
+        """Validate every tx of `block`; ONE device dispatch total.
+        Writes the txflags bitmap into the block metadata and returns
+        the flags (reference: validator.go:182-267)."""
+        return self.finish(self.stage(block))
 
     def _finish_tx(self, work: _TxWork, mask, applied_vp) -> int:
         if work.flag != V.NOT_VALIDATED:

@@ -1,0 +1,42 @@
+"""Deterministic time source for timer-driven components.
+
+(reference test model: etcd/raft drives its FSM with explicit Tick()
+calls instead of wall-clock timers, which is why its election tests
+are deterministic; scripts/run-unit-tests.sh runs them under load
+without flaking.  ManualClock gives RaftNode the same property: tests
+advance time explicitly, so CPU starvation cannot fire spurious
+elections or miss heartbeats.)
+
+Components accept a `clock` with `monotonic()`; if the clock also has
+`subscribe(cb)`, the component registers a wakeup callback and
+`advance()` invokes every callback after moving time — that nudges
+queue-blocked FSM threads to re-evaluate their (fake) deadlines.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List
+
+
+class ManualClock:
+    def __init__(self, start: float = 0.0):
+        self._t = start
+        self._lock = threading.Lock()
+        self._subs: List[Callable[[], None]] = []
+
+    def monotonic(self) -> float:
+        with self._lock:
+            return self._t
+
+    def subscribe(self, cb: Callable[[], None]) -> None:
+        with self._lock:
+            self._subs.append(cb)
+
+    def advance(self, dt: float) -> None:
+        """Move time forward and wake every subscriber."""
+        assert dt >= 0
+        with self._lock:
+            self._t += dt
+            subs = list(self._subs)
+        for cb in subs:
+            cb()

@@ -1,0 +1,97 @@
+"""Race-detection analog: lock-order + thread-ownership checking.
+
+(reference: scripts/run-unit-tests.sh:142-161 runs the whole unit
+suite under the Go race detector.  Python has no -race; what bites
+in this codebase's threaded core are (a) lock-order inversions
+(deadlocks) and (b) structures owned by one thread being mutated from
+another.  This module makes both crash loudly instead of corrupting
+silently: OrderedLock enforces a global lock hierarchy per thread,
+ThreadOwnership pins a structure to its owning thread.  Both are
+cheap enough to stay ON in production paths; the seeded interleaving
+stress tier (tests/test_racecheck.py) drives them hard and proves via
+injected-race canaries that they actually bite.)
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class RaceError(AssertionError):
+    """A detected race/ordering violation (AssertionError so test
+    frameworks treat it as a hard failure, never a skip)."""
+
+
+_tls = threading.local()
+
+
+def _held():
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+class OrderedLock:
+    """An RLock with a rank in a global hierarchy: a thread may only
+    acquire ranks STRICTLY ABOVE the highest it already holds (re-
+    entry on the same lock is fine).  Any inversion — the classic
+    AB/BA deadlock shape — raises RaceError at acquire time, on the
+    first interleaving that exhibits it, instead of deadlocking one
+    run in a thousand."""
+
+    def __init__(self, rank: int, name: str = ""):
+        self.rank = rank
+        self.name = name or f"lock@{rank}"
+        self._lock = threading.RLock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        held = _held()
+        if held and held[-1][0] >= self.rank and held[-1][1] is not self:
+            raise RaceError(
+                f"lock-order violation: acquiring {self.name} "
+                f"(rank {self.rank}) while holding "
+                f"{held[-1][1].name} (rank {held[-1][0]}) — the "
+                f"hierarchy requires strictly increasing ranks")
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            held.append((self.rank, self))
+        return ok
+
+    def release(self):
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][1] is self:
+                del held[i]
+                break
+        self._lock.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class ThreadOwnership:
+    """Pins a structure to one owning thread.  `claim()` binds the
+    current thread (the FSM/worker thread at startup); `guard()`
+    raises when any OTHER thread enters a guarded section.  The
+    raft FSM's whole design contract — all state transitions on the
+    FSM thread (chain.go:533's single-threaded run loop) — becomes
+    machine-checked instead of a docstring."""
+
+    def __init__(self, name: str = "structure"):
+        self.name = name
+        self._owner: Optional[int] = None
+
+    def claim(self) -> None:
+        self._owner = threading.get_ident()
+
+    def guard(self) -> None:
+        if self._owner is None:
+            return                        # not yet claimed (startup)
+        me = threading.get_ident()
+        if me != self._owner:
+            raise RaceError(
+                f"thread-ownership violation: {self.name} touched "
+                f"from thread {me}, owned by {self._owner}")

@@ -50,3 +50,18 @@ def test_dryrun_multichip_entrypoint():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+def test_ragged_batch_pads_into_mesh_divisible_bucket():
+    """A batch smaller than the mesh size still shards: it pads into
+    the smallest mesh-divisible bucket (some devices receive only
+    padding) instead of silently dropping the mesh — the divisibility
+    'cliff' is a pad, never a skip."""
+    from fabric_mod_tpu.bccsp.tpu import TpuVerifier, _bucket
+    from fabric_mod_tpu.parallel import data_mesh
+
+    assert _bucket(3, 8) == 8             # 3 items, 8 devices
+    assert _bucket(5, 2) == 8
+    items, expect = _items(3)
+    got = TpuVerifier(mesh=data_mesh(8)).verify_many(items)
+    assert list(got) == expect

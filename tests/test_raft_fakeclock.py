@@ -1,0 +1,127 @@
+"""Deterministic raft timer tests on a manual clock.
+
+(reference test model: etcd/raft's tick-driven tests — election and
+re-election outcomes depend only on the tick sequence, never on how
+loaded the CI machine is.  These are the load-immune versions of the
+kill-harness assertions in test_raft.py: wall-clock never decides,
+only ManualClock.advance calls do.)
+"""
+import time
+
+from fabric_mod_tpu.orderer.raft import RaftNode, RaftTransport
+from fabric_mod_tpu.utils.fakeclock import ManualClock
+
+
+def _advance_until(clock, pred, step=0.02, max_steps=80):
+    """Step fake time finely so the EARLIEST pending timer fires alone
+    (coarse jumps would expire every node's timeout in one wave and
+    split the vote — randomized timeouts only help when time moves
+    continuously)."""
+    for _ in range(max_steps):
+        if _settle(pred, timeout=0.2):
+            return True
+        clock.advance(step)
+    return _settle(pred)
+
+
+def _settle(pred, timeout=5.0):
+    """Wait (REAL time) for the FSM threads to process queued work —
+    message passing is still thread-based; only TIMERS are faked."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return pred()
+
+
+def _cluster(tmp_path, clock, ids=("a", "b", "c"), rngs=None):
+    import random
+    transport = RaftTransport()
+    applied = {i: [] for i in ids}
+    nodes = {}
+    for i in ids:
+        nodes[i] = RaftNode(
+            i, list(ids), transport, str(tmp_path / f"{i}.wal"),
+            lambda idx, data, i=i: applied[i].append(data),
+            clock=clock,
+            # distinct seeds: node 'a' always draws the shortest
+            # election timeout, making the winner deterministic
+            rng=random.Random({"a": 1, "b": 2, "c": 3}.get(i, 7)))
+    for n in nodes.values():
+        n.start()
+    return transport, nodes, applied
+
+
+def test_no_time_no_election(tmp_path):
+    """With the clock frozen, NOTHING happens — no spurious elections
+    regardless of how long real time passes (the exact failure mode of
+    the load-flaky wall-clock tests)."""
+    clock = ManualClock()
+    _, nodes, _ = _cluster(tmp_path, clock)
+    try:
+        time.sleep(0.5)                   # real seconds pass; fake none
+        assert all(n.state == "follower" for n in nodes.values())
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_advance_elects_exactly_one_leader(tmp_path):
+    clock = ManualClock()
+    _, nodes, applied = _cluster(tmp_path, clock)
+    try:
+        # stepping to the smallest draw starts ONE campaign; its
+        # term bump + vote grants reset the other timers
+        assert _advance_until(clock, lambda: sum(
+            n.state == "leader" for n in nodes.values()) == 1)
+        leader = next(n for n in nodes.values() if n.state == "leader")
+        # replication needs no further time: appends are message-driven
+        leader.propose(b"x1")
+        leader.propose(b"x2")
+        assert _settle(lambda: all(len(a) >= 2
+                                   for a in applied.values())), applied
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_leader_silence_triggers_reelection_on_advance(tmp_path):
+    clock = ManualClock()
+    transport, nodes, _ = _cluster(tmp_path, clock)
+    try:
+        assert _advance_until(clock, lambda: sum(
+            n.state == "leader" for n in nodes.values()) == 1)
+        leader = next(n for n in nodes.values() if n.state == "leader")
+        # partition the leader (its heartbeats stop arriving), then
+        # step past the followers' election timeouts: a NEW leader
+        # must emerge among the remaining two — deterministically
+        transport.partitioned.add(leader.id)
+        rest = [n for n in nodes.values() if n.id != leader.id]
+        assert _advance_until(clock, lambda: sum(
+            n.state == "leader" for n in rest) == 1), \
+            [(n.id, n.state) for n in nodes.values()]
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_heartbeats_on_advance_keep_leader_stable(tmp_path):
+    """Repeated advances below the election timeout, with heartbeats
+    flowing, never depose the leader — the timers interact correctly
+    in fake time."""
+    clock = ManualClock()
+    _, nodes, _ = _cluster(tmp_path, clock)
+    try:
+        assert _advance_until(clock, lambda: sum(
+            n.state == "leader" for n in nodes.values()) == 1)
+        leader = next(n for n in nodes.values() if n.state == "leader")
+        for _ in range(20):
+            clock.advance(0.05)           # heartbeat cadence
+            assert _settle(lambda: all(
+                n.leader_id == leader.id for n in nodes.values()))
+        assert leader.state == "leader"
+        assert sum(n.state == "leader" for n in nodes.values()) == 1
+    finally:
+        for n in nodes.values():
+            n.stop()
