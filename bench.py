@@ -50,6 +50,134 @@ def make_items(n: int, n_keys: int = 64):
                              seed=b"bench")
 
 
+def measure_marshal(n: int, reps: int) -> tuple:
+    """Host marshalling microbench: the vectorized batch path
+    (bccsp/tpu.marshal_items) vs the pre-overhaul per-item python loop
+    (reproduced verbatim below), same items, outputs asserted
+    identical.  Pure host work — no device, no jit."""
+    import numpy as np
+
+    from fabric_mod_tpu.bccsp import sw as _sw
+    from fabric_mod_tpu.bccsp.tpu import _LOW_S_MAX, marshal_items
+
+    items, _ = make_items(n)
+    size = n
+
+    def per_item_loop():
+        # The old TpuVerifier.verify_many_async marshalling loop,
+        # kept as the A/B baseline.
+        d = np.zeros((size, 32), np.uint8)
+        r = np.zeros((size, 32), np.uint8)
+        s = np.zeros((size, 32), np.uint8)
+        qx = np.zeros((size, 32), np.uint8)
+        qy = np.zeros((size, 32), np.uint8)
+        pre_ok = np.zeros(size, bool)
+        for i, it in enumerate(items):
+            try:
+                ri, si = _sw.decode_dss_signature(it.signature)
+                if not (len(it.digest) == 32 and len(it.public_xy) == 64):
+                    continue
+                if si > _LOW_S_MAX:
+                    continue
+                r[i] = np.frombuffer(ri.to_bytes(32, "big"), np.uint8)
+                s[i] = np.frombuffer(si.to_bytes(32, "big"), np.uint8)
+                d[i] = np.frombuffer(it.digest, np.uint8)
+                qx[i] = np.frombuffer(it.public_xy[:32], np.uint8)
+                qy[i] = np.frombuffer(it.public_xy[32:], np.uint8)
+                pre_ok[i] = True
+            except Exception:
+                continue
+        return d, r, s, qx, qy, pre_ok
+
+    loop_out = per_item_loop()                   # warm-up + reference
+    vec_out = marshal_items(items, size)
+    if not np.array_equal(vec_out[5], loop_out[5]):
+        raise AssertionError("vectorized marshal diverges on pre_ok")
+    # value planes compared on pre_ok rows only: the old loop zeroes
+    # rejected rows, the batch path leaves decoded-but-masked bytes
+    # (both are discarded — pre_ok gates the verdict)
+    okrows = vec_out[5]
+    for a, b, name in zip(vec_out, loop_out, ("d", "r", "s", "qx", "qy")):
+        if not np.array_equal(a[okrows], b[okrows]):
+            raise AssertionError(f"vectorized marshal diverges on {name}")
+
+    # INTERLEAVED min-of-k timing: the two paths alternate windows so
+    # noisy-neighbor slowdowns hit both alike, and the fastest window
+    # of each stands in for the uncontended cost — the ratio is then
+    # a property of the code, not of the machine's mood.
+    loop_best = vec_best = float("inf")
+    for _ in range(max(reps, 7)):
+        t0 = time.perf_counter()
+        per_item_loop()
+        loop_best = min(loop_best, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        marshal_items(items, size)
+        vec_best = min(vec_best, time.perf_counter() - t0)
+    loop_rate = n / loop_best
+    vec_rate = n / vec_best
+    backend = "openssl" if _sw.HAVE_CRYPTOGRAPHY else "pure-python-scalar"
+    log(f"per-item loop ({backend} DER): {loop_rate:,.0f} items/s; "
+        f"vectorized: {vec_rate:,.0f} items/s "
+        f"({vec_rate / loop_rate:.1f}x)")
+    return vec_rate, loop_rate
+
+
+def measure_diffverify(n: int) -> tuple:
+    """Differential acceptance check: the mixed-addition ladder core
+    must produce IDENTICAL verdicts to the projective core on n
+    randomized signatures including invalid and edge-case lanes.
+    Chunked through the 2048 bucket so each core compiles once."""
+    import numpy as np
+
+    from fabric_mod_tpu.bccsp.tpu import marshal_items
+    from fabric_mod_tpu.ops import p256
+
+    items, expect = make_items(n, n_keys=32)
+    # the one tested marshalling path; copies because the edge-case
+    # lanes below mutate the planes (fast-path outputs are read-only)
+    d, r, s, qx, qy, _pre_ok = (a.copy() if isinstance(a, np.ndarray)
+                                else a for a in marshal_items(items, n))
+    # adversarial/edge lanes sprinkled across the batch (mirrors
+    # tests/test_p256.py's negatives): tampered digest, wrong key,
+    # zero/overrange scalars, off-curve key, (0,0) key, high-s mirror
+    N_ORDER = p256.N
+    for base in range(0, n - 8, 97):
+        d[base][0] ^= 1
+        qx[base + 1], qy[base + 1] = qx[base + 2], qy[base + 2]
+        s[base + 3][:] = 0
+        r[base + 4][:] = np.frombuffer(
+            N_ORDER.to_bytes(32, "big"), np.uint8)
+        qy[base + 5][31] ^= 1
+        qx[base + 6][:] = 0
+        qy[base + 6][:] = 0
+        s_int = int.from_bytes(bytes(s[base + 7]), "big")
+        if 0 < s_int < N_ORDER:
+            s[base + 7] = np.frombuffer(
+                (N_ORDER - s_int).to_bytes(32, "big"), np.uint8)
+
+    # pad to a whole number of 2048 chunks so each core compiles ONCE
+    # (a remainder chunk would mint a second multi-minute program
+    # shape); zero rows fail range_ok identically in both cores
+    pad = (-n) % 2048
+    if pad:
+        z = np.zeros((pad, 32), np.uint8)
+        d, r, s = (np.concatenate([a, z]) for a in (d, r, s))
+        qx, qy = (np.concatenate([a, z]) for a in (qx, qy))
+
+    mismatches = 0
+    t0 = time.perf_counter()
+    for lo in range(0, n + pad, 2048):
+        hi = lo + 2048
+        core_args, range_ok = p256.marshal_inputs(
+            d[lo:hi], r[lo:hi], s[lo:hi], qx[lo:hi], qy[lo:hi])
+        proj = np.asarray(p256.verify_core(*core_args)) & range_ok
+        mixed = np.asarray(p256.verify_core_mixed(*core_args)) & range_ok
+        mismatches += int((proj != mixed).sum())
+    log(f"diffverify: {n} signatures in {time.perf_counter() - t0:.1f}s, "
+        f"{mismatches} verdict mismatches")
+    return n, mismatches
+
+
 def measure_sw(items, expect) -> float:
     from fabric_mod_tpu.bccsp.sw import SwCSP
 
@@ -72,7 +200,10 @@ def measure_device(items, expect, reps: int) -> float:
     devs = jax.devices()
     log(f"jax platform: {devs[0].platform}, {len(devs)} device(s), "
         f"backend init {time.perf_counter() - t0:.1f}s")
-    v = TpuVerifier()
+    # memo-cache OFF: reps re-verify identical items, and a cache hit
+    # would measure the LRU, not the device (the gossip metric is the
+    # cache's honest showcase — its redelivery shape is real)
+    v = TpuVerifier(cache_size=0)
     t0 = time.perf_counter()
     got = v.verify_many(items)          # includes compile on cold cache
     log(f"warm-up (incl. compile): {time.perf_counter() - t0:.1f}s")
@@ -151,7 +282,9 @@ def measure_block(n_txs: int, reps: int) -> tuple:
     sw_validator = make_validator(FakeBatchVerifier(SwCSP()))
     sw_rate = run(sw_validator, 1)
     log(f"sw block validation: {sw_rate:,.0f} tx/s")
-    dev_validator = make_validator(TpuVerifier())
+    # cache off for the same reason as measure_device: reps replay one
+    # block, production validates distinct blocks
+    dev_validator = make_validator(TpuVerifier(cache_size=0))
     t0 = time.perf_counter()
     run(dev_validator, 1)                   # warm-up/compile
     log(f"block warm-up (incl. compile): {time.perf_counter() - t0:.1f}s")
@@ -326,6 +459,57 @@ def run_worker(args) -> int:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
+
+    # A/B knobs for the pipelined front-end (all runtime-read env vars,
+    # set before any fabric_mod_tpu construction):
+    #   --mixed-add    -> affine-table mixed-addition ladder
+    #   --memo-cache   -> verdict memo-cache size (0 disables)
+    #   --inflight     -> in-flight dispatch window depth
+    #   --precision    -> limb matmul precision (BENCH-SCOPED; the env
+    #                     var is only honored through this entrypoint)
+    if args.mixed_add is not None:
+        os.environ["FABRIC_MOD_TPU_MIXED_ADD"] = str(args.mixed_add)
+    if args.memo_cache is not None:
+        os.environ["FABRIC_MOD_TPU_VERDICT_CACHE"] = str(args.memo_cache)
+    if args.inflight is not None:
+        os.environ["FABRIC_MOD_TPU_INFLIGHT"] = str(args.inflight)
+    precision = (args.precision
+                 or os.environ.get("FABRIC_MOD_TPU_PRECISION", "highest"))
+    if precision.lower() == "high":
+        from fabric_mod_tpu.ops import limbs9
+        limbs9.set_precision_mode("high")
+
+    if args.metric == "marshal":
+        from fabric_mod_tpu.bccsp.sw import HAVE_CRYPTOGRAPHY
+        vec_rate, loop_rate = measure_marshal(args.batch,
+                                              max(3, args.reps))
+        out = {
+            "metric": f"marshal_items_per_sec_{args.batch}_bucket",
+            "value": round(vec_rate, 1),
+            "unit": "items/s",
+            "vs_baseline": round(vec_rate / loop_rate, 3),
+            # the per-item loop decodes DER through whichever scalar
+            # parser the platform has — label it so ratios are only
+            # compared like-for-like across rounds
+            "baseline_der": "openssl" if HAVE_CRYPTOGRAPHY
+                            else "pure-python-scalar",
+        }
+        # host-only metric: no device banner needed
+        print(json.dumps(out))
+        return 0
+    if args.metric == "diffverify":
+        n, mismatches = measure_diffverify(args.batch)
+        out = {
+            "metric": "mixed_ladder_verdict_differential",
+            "value": float(n),
+            "unit": "signatures",
+            "vs_baseline": 1.0 if mismatches == 0 else 0.0,
+            "mismatches": mismatches,
+        }
+        import jax
+        out["platform"] = jax.devices()[0].platform
+        print(json.dumps(out))
+        return 0 if mismatches == 0 else 1
     if args.metric == "block":
         dev_rate, sw_rate = measure_block(min(args.batch, 1000), args.reps)
         out = {
@@ -365,6 +549,7 @@ def run_worker(args) -> int:
             "pipeline_split": stats,
         }
     else:
+        from fabric_mod_tpu.bccsp.sw import HAVE_CRYPTOGRAPHY
         items, expect = make_items(args.batch)
         sw_rate = measure_sw(items, expect)
         log(f"sw baseline: {sw_rate:,.0f} verifies/s")
@@ -376,6 +561,10 @@ def run_worker(args) -> int:
             "value": round(dev_rate, 1),
             "unit": "verifies/s",
             "vs_baseline": round(dev_rate / sw_rate, 3),
+            # the ratio is only comparable across rounds when the sw
+            # baseline ran the same backend — label it
+            "sw_backend": "openssl" if HAVE_CRYPTOGRAPHY
+                          else "pure-python-fallback",
         }
     import jax
     out["platform"] = jax.devices()[0].platform
@@ -509,10 +698,24 @@ def main() -> int:
     ap.add_argument("--batch", type=int, default=2048)
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--metric",
-                    choices=("verify", "block", "e2e", "idemix", "gossip"),
+                    choices=("verify", "block", "e2e", "idemix", "gossip",
+                             "marshal", "diffverify"),
                     default="verify")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend")
+    # pipelined-front-end A/B knobs (see run_worker)
+    ap.add_argument("--mixed-add", type=int, choices=(0, 1), default=None,
+                    help="1: affine-table mixed-addition ladder "
+                         "(FABRIC_MOD_TPU_MIXED_ADD)")
+    ap.add_argument("--memo-cache", type=int, default=None,
+                    help="verdict memo-cache capacity, 0 disables "
+                         "(FABRIC_MOD_TPU_VERDICT_CACHE)")
+    ap.add_argument("--inflight", type=int, default=None,
+                    help="in-flight dispatch window depth "
+                         "(FABRIC_MOD_TPU_INFLIGHT)")
+    ap.add_argument("--precision", choices=("highest", "high"),
+                    default=None,
+                    help="limb matmul precision — bench-scoped A/B only")
     ap.add_argument("--_worker", action="store_true",
                     help=argparse.SUPPRESS)
     args, _ = ap.parse_known_args()
@@ -522,6 +725,14 @@ def main() -> int:
 
     argv = ["--batch", str(args.batch), "--reps", str(args.reps),
             "--metric", args.metric]
+    if args.mixed_add is not None:
+        argv += ["--mixed-add", str(args.mixed_add)]
+    if args.memo_cache is not None:
+        argv += ["--memo-cache", str(args.memo_cache)]
+    if args.inflight is not None:
+        argv += ["--inflight", str(args.inflight)]
+    if args.precision is not None:
+        argv += ["--precision", args.precision]
     return supervise(args, argv)
 
 

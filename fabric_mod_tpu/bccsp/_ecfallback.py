@@ -1,0 +1,374 @@
+"""Pure-python P-256 ECDSA fallback — dependency gate for `cryptography`.
+
+The sw provider (bccsp/sw.py) fronts OpenSSL via the `cryptography`
+package, but not every deployment image ships it (this container's
+tier-1 environment does not).  Rather than letting a missing wheel
+take down every signature fixture, the sw baseline, and half the test
+suite at import time, this module provides a minimal, slow, correct
+P-256 ECDSA in python ints with exactly the micro-API surface sw.py
+touches — so `bccsp.sw` degrades to it transparently.
+
+Scope is deliberately tiny: P-256 keygen / deterministic-k (RFC 6979)
+sign / verify, uncompressed-point encode/decode, and DER
+ECDSA-Sig-Value encode/decode (decode shared with bccsp/der.py so the
+two parsers cannot drift).  P-384, PEM serialization, and AES raise
+with a clear "install cryptography" message instead of failing
+mysteriously.  Performance is ~ms per operation — fine for fixtures
+and baselines, never the production verify path (that is the device's
+job).
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets
+
+# NIST P-256 domain parameters (public constants; duplicated from
+# ops/p256.py on purpose — this module must import without jax).
+P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+B = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
+GX = 0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296
+GY = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5
+
+
+class InvalidSignature(Exception):
+    """Verification failure (mirrors cryptography.exceptions)."""
+
+
+class UnsupportedByFallback(RuntimeError):
+    """Feature outside the fallback's scope — install `cryptography`."""
+
+    def __init__(self, what: str):
+        super().__init__(
+            f"{what} requires the 'cryptography' package, which is not "
+            f"installed; the pure-python fallback only covers P-256 "
+            f"keygen/sign/verify")
+
+
+# --- affine curve arithmetic (python ints; None is the identity) -----------
+
+def point_add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2 and (y1 + y2) % P == 0:
+        return None
+    if p1 == p2:
+        lam = (3 * x1 * x1 - 3) * pow(2 * y1, -1, P) % P
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, -1, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def _jac_double(p):
+    """Jacobian doubling for a = -3 (None is the identity)."""
+    if p is None:
+        return None
+    x, y, z = p
+    if y == 0:
+        return None
+    ysq = y * y % P
+    s = 4 * x * ysq % P
+    zz = z * z % P
+    m = 3 * (x - zz) * (x + zz) % P
+    nx = (m * m - 2 * s) % P
+    ny = (m * (s - nx) - 8 * ysq * ysq) % P
+    nz = 2 * y * z % P
+    return (nx, ny, nz)
+
+
+def _jac_add_affine(p, q):
+    """Jacobian p + affine q (mixed addition; None is the identity)."""
+    if q is None:
+        return p
+    if p is None:
+        return (q[0], q[1], 1)
+    x1, y1, z1 = p
+    x2, y2 = q
+    z1z1 = z1 * z1 % P
+    u2 = x2 * z1z1 % P
+    s2 = y2 * z1 * z1z1 % P
+    if u2 == x1:
+        if s2 == y1 % P:
+            return _jac_double(p)
+        return None
+    h = (u2 - x1) % P
+    hh = h * h % P
+    i = 4 * hh % P
+    j = h * i % P
+    rr = 2 * (s2 - y1) % P
+    v = x1 * i % P
+    nx = (rr * rr - j - 2 * v) % P
+    ny = (rr * (v - nx) - 2 * y1 * j) % P
+    nz = 2 * z1 * h % P
+    return (nx, ny, nz)
+
+
+def point_mul(k: int, pt):
+    """k * pt via Jacobian double-and-add — ONE final inversion
+    instead of one per point operation (the fallback's hot loop)."""
+    if pt is None or k % N == 0:
+        return None
+    acc = None
+    for bit in bin(k)[2:]:
+        acc = _jac_double(acc)
+        if bit == "1":
+            acc = _jac_add_affine(acc, pt)
+    if acc is None:
+        return None
+    zi = pow(acc[2], -1, P)
+    zi2 = zi * zi % P
+    return (acc[0] * zi2 % P, acc[1] * zi2 * zi % P)
+
+
+def on_curve(x: int, y: int) -> bool:
+    return (0 <= x < P and 0 <= y < P
+            and (y * y - (x * x * x - 3 * x + B)) % P == 0)
+
+
+# --- DER ECDSA-Sig-Value ----------------------------------------------------
+
+def encode_dss_signature(r: int, s: int) -> bytes:
+    def integer(v: int) -> bytes:
+        if v < 0:
+            raise ValueError("negative integer in signature")
+        body = v.to_bytes((v.bit_length() + 7) // 8 or 1, "big")
+        if body[0] & 0x80:
+            body = b"\x00" + body
+        return b"\x02" + bytes([len(body)]) + body
+    body = integer(r) + integer(s)
+    if len(body) >= 0x80:
+        raise ValueError("signature too large for short-form DER")
+    return b"\x30" + bytes([len(body)]) + body
+
+
+def decode_dss_signature(sig: bytes):
+    """Strict scalar DER decode, grammar-equivalent to the batch
+    decoder (bccsp/der.py) — tests/test_verify_frontend.py fuzzes the
+    two against each other so they cannot drift.  A plain index parse
+    on purpose: per-item callers (fallback sign/verify, the bench's
+    per-item baseline loop) must not pay the batch decoder's
+    per-call numpy setup."""
+    ln = len(sig)
+    if ln < 8 or ln > 72 or sig[0] != 0x30:
+        raise ValueError("invalid ECDSA-Sig-Value DER")
+    if sig[1] >= 0x80 or sig[1] + 2 != ln:
+        raise ValueError("invalid ECDSA-Sig-Value DER")
+
+    def integer(off: int):
+        if off + 2 > ln or sig[off] != 0x02:
+            raise ValueError("invalid ECDSA-Sig-Value DER")
+        ilen = sig[off + 1]
+        end = off + 2 + ilen
+        if ilen < 1 or ilen > 33 or end > ln:
+            raise ValueError("invalid ECDSA-Sig-Value DER")
+        body = sig[off + 2:end]
+        if body[0] & 0x80:
+            raise ValueError("negative INTEGER")
+        if body[0] == 0 and ilen > 1 and body[1] < 0x80:
+            raise ValueError("non-minimal INTEGER")
+        if ilen == 33 and body[0] != 0:
+            raise ValueError("INTEGER too wide")
+        return int.from_bytes(body, "big"), end
+
+    r, off = integer(2)
+    s, off = integer(off)
+    if off != ln:
+        raise ValueError("trailing garbage after ECDSA-Sig-Value")
+    return r, s
+
+
+# --- RFC 6979 deterministic nonce ------------------------------------------
+
+def _rfc6979_k(d: int, e: int) -> int:
+    holen = 32
+    x = d.to_bytes(32, "big")
+    h1 = (e % N).to_bytes(32, "big")
+    v = b"\x01" * holen
+    k = b"\x00" * holen
+    k = hmac.new(k, v + b"\x00" + x + h1, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    k = hmac.new(k, v + b"\x01" + x + h1, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        v = hmac.new(k, v, hashlib.sha256).digest()
+        cand = int.from_bytes(v, "big")
+        if 1 <= cand < N:
+            return cand
+        k = hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(k, v, hashlib.sha256).digest()
+
+
+# --- the cryptography-shaped micro-API sw.py consumes ----------------------
+
+class SECP256R1:
+    name = "secp256r1"
+
+
+class SECP384R1:
+    name = "secp384r1"
+
+
+class ECDSA:
+    """Signature-algorithm marker (digest is pre-hashed throughout)."""
+
+    def __init__(self, algorithm=None):
+        self.algorithm = algorithm
+
+
+class Prehashed:
+    def __init__(self, algorithm=None):
+        self.algorithm = algorithm
+
+
+class EllipticCurvePublicNumbers:
+    def __init__(self, x: int, y: int, curve=None):
+        self.x = x
+        self.y = y
+
+    def public_key(self):
+        return EllipticCurvePublicKey(self.x, self.y)
+
+
+class EllipticCurvePublicKey:
+    curve = SECP256R1()
+
+    def __init__(self, x: int, y: int):
+        if not on_curve(x, y):
+            raise ValueError("point is not on P-256")
+        self._x, self._y = x, y
+
+    @classmethod
+    def from_encoded_point(cls, curve, data: bytes):
+        if not isinstance(curve, SECP256R1):
+            raise UnsupportedByFallback("non-P256 key import")
+        if len(data) != 65 or data[0] != 0x04:
+            raise ValueError("only uncompressed points are supported")
+        return cls(int.from_bytes(data[1:33], "big"),
+                   int.from_bytes(data[33:], "big"))
+
+    def public_numbers(self):
+        return EllipticCurvePublicNumbers(self._x, self._y)
+
+    def public_bytes(self, encoding=None, fmt=None) -> bytes:
+        return (b"\x04" + self._x.to_bytes(32, "big")
+                + self._y.to_bytes(32, "big"))
+
+    def verify(self, signature: bytes, digest: bytes, alg=None) -> None:
+        try:
+            r, s = decode_dss_signature(signature)
+        except ValueError:
+            raise InvalidSignature("bad DER")
+        if not (1 <= r < N and 1 <= s < N):
+            raise InvalidSignature("scalar out of range")
+        e = int.from_bytes(digest[:32], "big")
+        w = pow(s, -1, N)
+        pt = point_add(point_mul(e * w % N, (GX, GY)),
+                       point_mul(r * w % N, (self._x, self._y)))
+        if pt is None or pt[0] % N != r:
+            raise InvalidSignature("verification failed")
+
+
+class EllipticCurvePrivateKey:
+    curve = SECP256R1()
+
+    def __init__(self, d: int):
+        self._d = d
+        self._pub = None
+
+    def public_key(self) -> EllipticCurvePublicKey:
+        if self._pub is None:
+            x, y = point_mul(self._d, (GX, GY))
+            self._pub = EllipticCurvePublicKey(x, y)
+        return self._pub
+
+    def sign(self, digest: bytes, alg=None) -> bytes:
+        e = int.from_bytes(digest[:32], "big")
+        d = self._d
+        k = _rfc6979_k(d, e)
+        while True:
+            pt = point_mul(k, (GX, GY))
+            r = pt[0] % N
+            s = pow(k, -1, N) * (e + r * d) % N
+            if r and s:
+                return encode_dss_signature(r, s)
+            k = (k + 1) % N or 1        # astronomically unlikely
+
+    def private_bytes(self, *a, **kw):
+        raise UnsupportedByFallback("PEM private-key serialization")
+
+
+def generate_private_key(curve) -> EllipticCurvePrivateKey:
+    if not isinstance(curve, SECP256R1):
+        raise UnsupportedByFallback("non-P256 key generation")
+    return EllipticCurvePrivateKey(secrets.randbelow(N - 1) + 1)
+
+
+# namespace shims so sw.py's call sites read identically ---------------------
+
+class _EcNamespace:
+    SECP256R1 = SECP256R1
+    SECP384R1 = SECP384R1
+    ECDSA = ECDSA
+    EllipticCurvePublicKey = EllipticCurvePublicKey
+    EllipticCurvePrivateKey = EllipticCurvePrivateKey
+    EllipticCurvePublicNumbers = EllipticCurvePublicNumbers
+    generate_private_key = staticmethod(generate_private_key)
+
+
+class _HashAlg:
+    def __init__(self, name):
+        self.name = name
+
+    def __call__(self):
+        return self
+
+
+class _HashesNamespace:
+    SHA256 = _HashAlg("sha256")
+    SHA384 = _HashAlg("sha384")
+
+
+class _Raiser:
+    """Attribute/call sink that defers the failure to first use."""
+
+    def __init__(self, what):
+        self._what = what
+
+    def __getattr__(self, name):
+        return _Raiser(f"{self._what}.{name}")
+
+    def __call__(self, *a, **kw):
+        raise UnsupportedByFallback(self._what)
+
+
+class _SerializationNamespace:
+    class Encoding:
+        X962 = "X962"
+        PEM = "PEM"
+
+    class PublicFormat:
+        UncompressedPoint = "UncompressedPoint"
+        SubjectPublicKeyInfo = "SubjectPublicKeyInfo"
+
+    class PrivateFormat:
+        PKCS8 = "PKCS8"
+
+    NoEncryption = _Raiser("serialization.NoEncryption")
+    load_pem_private_key = _Raiser("serialization.load_pem_private_key")
+    load_pem_public_key = _Raiser("serialization.load_pem_public_key")
+
+
+ec = _EcNamespace()
+hashes = _HashesNamespace()
+serialization = _SerializationNamespace()
+Cipher = _Raiser("AES Cipher")
+algorithms = _Raiser("AES algorithms")
+modes = _Raiser("AES modes")
+PKCS7 = _Raiser("PKCS7 padding")

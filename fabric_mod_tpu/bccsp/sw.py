@@ -14,13 +14,41 @@ import hashlib
 import os
 from typing import Optional, Sequence
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    Prehashed, decode_dss_signature, encode_dss_signature)
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
-from cryptography.hazmat.primitives.padding import PKCS7
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed, decode_dss_signature, encode_dss_signature)
+    from cryptography.hazmat.primitives.ciphers import (
+        Cipher, algorithms, modes)
+    from cryptography.hazmat.primitives.padding import PKCS7
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:
+    # Dependency gate: degrade to the pure-python P-256 fallback
+    # (bccsp/_ecfallback.py) instead of taking down every importer.
+    # P-256 keygen/sign/verify keep working (slowly); PEM, AES, and
+    # P-384 raise a clear UnsupportedByFallback at first USE.  Loud on
+    # purpose: the fallback's big-int math is ~1000x slower and NOT
+    # constant-time, so an image silently losing the wheel must leave
+    # a trace (same policy as limbs9.set_precision_mode).
+    import sys as _sys
+    print("fabric_mod_tpu: 'cryptography' wheel unavailable — bccsp/sw "
+          "degrading to the pure-python P-256 fallback (slow, "
+          "non-constant-time; PEM/AES/P-384 disabled).  Install "
+          "'cryptography' for production use.",
+          file=_sys.stderr, flush=True)
+    from fabric_mod_tpu.bccsp import _ecfallback as _fb
+    InvalidSignature = _fb.InvalidSignature
+    ec = _fb.ec
+    hashes = _fb.hashes
+    serialization = _fb.serialization
+    Cipher, algorithms, modes = _fb.Cipher, _fb.algorithms, _fb.modes
+    PKCS7 = _fb.PKCS7
+    Prehashed = _fb.Prehashed
+    decode_dss_signature = _fb.decode_dss_signature
+    encode_dss_signature = _fb.encode_dss_signature
+    HAVE_CRYPTOGRAPHY = False
 
 from fabric_mod_tpu.bccsp.api import BCCSP, Key, VerifyItem
 

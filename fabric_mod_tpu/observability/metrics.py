@@ -127,6 +127,7 @@ class MetricsProvider:
 
     def __init__(self):
         self._metrics: List[_Labeled] = []
+        self._named: Dict[Tuple[type, str], _Labeled] = {}
         self._lock = threading.Lock()
 
     def new_counter(self, opts: MetricOpts) -> Counter:
@@ -139,6 +140,31 @@ class MetricsProvider:
                       buckets: Sequence[float] = _DEFAULT_BUCKETS
                       ) -> Histogram:
         return self._register(Histogram(opts, buckets))
+
+    # -- get-or-create by full name ---------------------------------------
+    # For metrics declared by LIBRARY code that may instantiate many
+    # times (e.g. the bccsp verdict cache): every instance shares one
+    # registered metric instead of emitting duplicate exposition rows.
+
+    def counter(self, opts: MetricOpts) -> Counter:
+        return self._named_register(Counter, opts)
+
+    def gauge(self, opts: MetricOpts) -> Gauge:
+        return self._named_register(Gauge, opts)
+
+    def histogram(self, opts: MetricOpts,
+                  buckets: Sequence[float] = _DEFAULT_BUCKETS) -> Histogram:
+        return self._named_register(Histogram, opts, buckets)
+
+    def _named_register(self, kind, opts: MetricOpts, *extra):
+        key = (kind, opts.full_name)
+        with self._lock:
+            got = self._named.get(key)
+            if got is None:
+                got = kind(opts, *extra)
+                self._named[key] = got
+                self._metrics.append(got)
+            return got
 
     def _register(self, metric):
         with self._lock:

@@ -60,15 +60,54 @@ HALF = BASE // 2  # rounding offset
 
 # Exact f32 dot emulation on TPU (6-pass bf16). The operands here are
 # integers < 2^17 and sums < 2^22, so HIGHEST is bit-exact.
-# FABRIC_MOD_TPU_PRECISION=high selects the cheaper 3-pass emulation
-# for an on-chip A/B: it is exact ONLY for the 0/1 fold matrices (see
-# the split analysis below) — the differential suite must pass before
-# a HIGH number is trusted.
+#
+# The cheaper 3-pass emulation (Precision.HIGH) is exact ONLY for the
+# 0/1 fold matrices — it exists for an on-chip A/B, and it can make
+# verify verdicts silently WRONG if it leaks into production.  The
+# knob is therefore scoped to the bench entrypoint: bench.py calls
+# `set_precision_mode("high")` in its measurement worker; nothing else
+# may.  (ADVICE r5: the old FABRIC_MOD_TPU_PRECISION env var switched
+# every deployment that inherited it, with no runtime guard.)
 import os as _os
+import sys as _sys
 
-PRECISION = (jax.lax.Precision.HIGH
-             if _os.environ.get("FABRIC_MOD_TPU_PRECISION", "").lower()
-             == "high" else jax.lax.Precision.HIGHEST)
+PRECISION = jax.lax.Precision.HIGHEST
+
+
+def set_precision_mode(mode: str) -> str:
+    """Select the limb matmul precision ("highest" | "high").
+
+    BENCH-ONLY.  Returns the previous mode.  Must be called before the
+    first verify/pairing trace in the process — jitted programs bake
+    the precision at trace time and are NOT retraced.  Selecting
+    "high" emits a prominent warning: verdicts are only trustworthy
+    after the differential suite passes at that precision.
+    """
+    global PRECISION
+    prev = "high" if PRECISION == jax.lax.Precision.HIGH else "highest"
+    mode = (mode or "highest").lower()
+    if mode not in ("high", "highest"):
+        raise ValueError(f"unknown precision mode {mode!r}")
+    PRECISION = (jax.lax.Precision.HIGH if mode == "high"
+                 else jax.lax.Precision.HIGHEST)
+    if mode == "high":
+        print("=" * 70 + "\nWARNING: fabric_mod_tpu limb matmuls set to "
+              "Precision.HIGH (3-pass bf16\nemulation).  This is exact "
+              "ONLY for the 0/1 fold matrices; signature and\npairing "
+              "verdicts are NOT guaranteed until the differential suite "
+              "passes\nat this precision.  Bench A/B use only — never "
+              "production.\n" + "=" * 70, file=_sys.stderr, flush=True)
+    return prev
+
+
+if _os.environ.get("FABRIC_MOD_TPU_PRECISION", "").lower() == "high":
+    # The env var is no longer honored here (it used to silently change
+    # verify semantics in any process that inherited it).  The bench
+    # worker translates it via set_precision_mode; everyone else gets
+    # default precision and this notice.
+    print("fabric_mod_tpu: ignoring FABRIC_MOD_TPU_PRECISION=high outside "
+          "the bench entrypoint (see ops/limbs9.set_precision_mode)",
+          file=_sys.stderr, flush=True)
 
 _F = jnp.float32
 
@@ -477,3 +516,33 @@ def pow_static(a_mont: jnp.ndarray, exponent: int, spec: FieldSpec) -> jnp.ndarr
 def inv_mont(a_mont: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
     """Modular inverse in the Montgomery domain (Fermat; p prime)."""
     return pow_static(a_mont, spec.modulus - 2, spec)
+
+
+def inv_mont_many(vals, spec: FieldSpec) -> list:
+    """Montgomery's simultaneous-inversion trick: invert m Montgomery-
+    domain values with ONE Fermat inversion plus 3(m-1) multiplies.
+
+    `vals` is a python list of (K, ...batch) arrays (a static table,
+    e.g. the per-lane Q window table's Z coordinates); returns their
+    inverses in order.  All products/inverses are element-wise along
+    the batch axes, so lanes never mix.  A zero value poisons every
+    inverse OF ITS LANE (0^(p-2) = 0 propagates through the prefix
+    products) — callers rely on such lanes being masked out anyway
+    (an on-curve point of a prime-order curve never has Z = 0 in the
+    window table; only invalid keys do, and key_ok masks those).
+    """
+    m = len(vals)
+    if m == 0:
+        return []
+    if m == 1:
+        return [inv_mont(vals[0], spec)]
+    prefix = [vals[0]]
+    for v in vals[1:]:
+        prefix.append(mont_mul(prefix[-1], v, spec))
+    running = inv_mont(prefix[-1], spec)     # (v_0 * ... * v_{m-1})^-1
+    out = [None] * m
+    for i in range(m - 1, 0, -1):
+        out[i] = mont_mul(running, prefix[i - 1], spec)
+        running = mont_mul(running, vals[i], spec)
+    out[0] = running
+    return out

@@ -25,6 +25,13 @@ G table is a host-precomputed constant (selected by one-hot matmul on
 the MXU) and the 16-entry Q table is built on device per lane.  The
 final comparison avoids an inversion: accept iff X == (r + k*n)*Z
 (mod p) for k in {0, 1} (with r + k*n < p), Z != 0.
+
+Two ladder variants share that schedule: the original all-projective
+`shamir_ladder` (complete addition, alg. 4) and the affine-table
+`shamir_ladder_mixed` (complete MIXED addition, alg. 5, with the Q
+table normalized by one Montgomery simultaneous inversion) —
+selectable via FABRIC_MOD_TPU_MIXED_ADD, differentially tested to
+produce identical verdicts.
 """
 from __future__ import annotations
 
@@ -37,8 +44,8 @@ import numpy as np
 from fabric_mod_tpu.ops import limbs9 as limbs
 from fabric_mod_tpu.ops.limbs9 import (
     FieldSpec, K, add, sub, mont_mul, mont_sqr, to_mont, eq_zero,
-    mul_small, canonical, bits_le, inv_mont, be_bytes_to_limbs,
-    const_like, const_dot,
+    mul_small, canonical, bits_le, inv_mont, inv_mont_many,
+    be_bytes_to_limbs, const_like, const_dot,
 )
 
 WINDOW = 4                     # Shamir ladder window width (bits)
@@ -148,6 +155,60 @@ def point_add(p1, p2, fp: FieldSpec, b_m: jnp.ndarray):
     Y3 = mont_mul(b_m, Y3, fp)
     t1 = add(t2, t2)
     t2 = add(t1, t2)
+    Y3 = sub(Y3, t2)
+    Y3 = sub(Y3, t0)
+    t1 = add(Y3, Y3)
+    Y3 = add(t1, Y3)
+    t1 = add(t0, t0)
+    t0 = add(t1, t0)
+    t0 = sub(t0, t2)
+    t1 = mont_mul(t4, Y3, fp)
+    t2 = mont_mul(t0, Y3, fp)
+    Y3 = mont_mul(X3, Z3, fp)
+    Y3 = add(Y3, t2)
+    X3 = mont_mul(t3, X3, fp)
+    X3 = sub(X3, t1)
+    Z3 = mont_mul(t4, Z3, fp)
+    t1 = mont_mul(t3, t0, fp)
+    Z3 = add(Z3, t1)
+    return (X3, Y3, Z3)
+
+
+def point_add_mixed(p1, p2, fp: FieldSpec, b_m: jnp.ndarray):
+    """Complete MIXED addition (RCB alg. 5, a = -3): p1 projective,
+    p2 AFFINE (Z2 = 1 implicit), Montgomery domain.
+
+    Algorithm 4 with Z2 = 1 substituted: t2 degenerates to Z1 and the
+    three rank-1 cross products collapse (t4 = Y2*Z1 + Y1, the X-plane
+    twin = X2*Z1 + X1), dropping the Z1*Z2 multiply — 11 muls + 2
+    muls-by-b vs the full add's 12 + 2, and table entries need no Z
+    plane at all (2/3 of the one-hot select bandwidth).  Complete for
+    every projective p1 ON THE CURVE including infinity and p1 == ±p2;
+    p2 cannot encode infinity — callers select around zero windows
+    (see shamir_ladder_mixed).
+    """
+    X1, Y1, Z1 = p1
+    X2, Y2 = p2
+    t0 = mont_mul(X1, X2, fp)
+    t1 = mont_mul(Y1, Y2, fp)
+    t3 = add(X2, Y2)
+    t4 = add(X1, Y1)
+    t3 = mont_mul(t3, t4, fp)
+    t4 = add(t0, t1)
+    t3 = sub(t3, t4)
+    t4 = mont_mul(Y2, Z1, fp)
+    t4 = add(t4, Y1)
+    Y3 = mont_mul(X2, Z1, fp)
+    Y3 = add(Y3, X1)
+    Z3 = mont_mul(b_m, Z1, fp)
+    X3 = sub(Y3, Z3)
+    Z3 = add(X3, X3)
+    X3 = add(X3, Z3)
+    Z3 = sub(t1, X3)
+    X3 = add(t1, X3)
+    Y3 = mont_mul(b_m, Y3, fp)
+    t1 = add(Z1, Z1)
+    t2 = add(t1, Z1)
     Y3 = sub(Y3, t2)
     Y3 = sub(Y3, t0)
     t1 = add(Y3, Y3)
@@ -292,6 +353,100 @@ def shamir_ladder(u1_w: jnp.ndarray, u2_w: jnp.ndarray,
     return acc
 
 
+@functools.lru_cache(maxsize=None)
+def _g_table_affine():
+    """(2, TABLE-1, K) numpy constants: AFFINE Montgomery-domain
+    multiples [G, 2G, ..., 15G] — no Z plane, no infinity entry (the
+    zero window is handled by the mixed ladder's keep-select)."""
+    R = 1 << limbs.RBITS
+    xs, ys = [], []
+    acc = None
+    for _ in range(1, TABLE):
+        acc = _affine_add(acc, (GX, GY))
+        xs.append(limbs.int_to_limbs(acc[0] * R % P))
+        ys.append(limbs.int_to_limbs(acc[1] * R % P))
+    return np.stack([np.stack(xs), np.stack(ys)])
+
+
+def build_q_table_affine(qx_m, qy_m, fp: FieldSpec, b_m):
+    """[Q, 2Q, ..., 15Q] as AFFINE Montgomery-domain (x, y) pairs.
+
+    Built through the shared projective schedule (build_q_table) and
+    normalized with ONE batched Montgomery simultaneous inversion
+    (limbs9.inv_mont_many) — 1 Fermat inversion + 3(TABLE-2) muls for
+    the whole table instead of one inversion per entry.  All 128
+    table-adds of the ladder then take the cheaper mixed formula and
+    the one-hot selects move two planes instead of three.
+
+    Lanes whose key is invalid (off-curve / (0,0)) can hit Z = 0 in
+    the schedule; the simultaneous inversion then zeroes that LANE's
+    whole table — harmless, those lanes are masked by key_ok.
+    """
+    batch = qx_m.shape[1:]
+    inf_pt = infinity(batch)
+    qtab = build_q_table((qx_m, qy_m, inf_pt[1]), inf_pt, fp, b_m)[1:]
+    zinv = inv_mont_many([pt[2] for pt in qtab], fp)
+    ax = [mont_mul(pt[0], zi, fp) for pt, zi in zip(qtab, zinv)]
+    ay = [mont_mul(pt[1], zi, fp) for pt, zi in zip(qtab, zinv)]
+    return ax, ay
+
+
+def shamir_ladder_mixed(u1_w: jnp.ndarray, u2_w: jnp.ndarray,
+                        qx_m: jnp.ndarray, qy_m: jnp.ndarray):
+    """The windowed Shamir ladder over AFFINE tables + complete mixed
+    additions — same contract as `shamir_ladder` (identical verdicts;
+    the projective representative differs by a Z scale).
+
+    Both window tables are affine (G: host constant; Q: device-built
+    then normalized by one simultaneous inversion), so every table-add
+    is RCB algorithm 5 and the one-hot selects move x/y only.  Affine
+    tables cannot encode the infinity entry a zero window used to
+    select; instead the add runs unconditionally against whatever the
+    all-zero one-hot produces and a keep-select drops it — branch-free
+    (the same reason the complete formulas are used at all).
+
+    Selected by FABRIC_MOD_TPU_MIXED_ADD=1 (bccsp buckets route
+    through `verify_core_mixed`); dark by default until on-chip
+    measurement confirms it, like the Pallas ladder before it.
+    """
+    fp, _fn, b_m_np, _, _ = _consts()
+    batch = qx_m.shape[1:]
+    b_m = const_like(b_m_np, qx_m)
+
+    ax, ay = build_q_table_affine(qx_m, qy_m, fp, b_m)
+    q_tab = (jnp.stack(ax, axis=0), jnp.stack(ay, axis=0))
+    g_aff = _g_table_affine()                        # (2, TABLE-1, K)
+    sel_seq = jnp.stack([u1_w, u2_w], axis=1)        # (NW, 2, batch)
+
+    def add_selected(acc, w, p2):
+        """Mixed-add the selected affine point; keep acc on w == 0
+        (the affine table has no infinity row — the one-hot is all
+        zero there and the formula output is discarded)."""
+        added = point_add_mixed(acc, p2, fp, b_m)
+        keep = (w == 0)[None]
+        return tuple(jnp.where(keep, a, n) for a, n in zip(acc, added))
+
+    def step(acc, w2):
+        acc = jax.lax.fori_loop(
+            0, WINDOW, lambda _i, a: point_double(a, fp, b_m), acc)
+        # Q-table select: one-hot reduce over the per-lane AFFINE
+        # table (w-1 indexed; w == 0 yields a zero one-hot).
+        oh_q = jax.nn.one_hot(w2[1] - 1, TABLE - 1, dtype=jnp.float32,
+                              axis=0)
+        acc = add_selected(acc, w2[1], tuple(
+            jnp.sum(oh_q[:, None] * q_tab[c], axis=0) for c in range(2)))
+        # G-table select: constant table -> one-hot matmul (MXU,
+        # precision-pinned — table limbs reach 511).
+        oh_g = jax.nn.one_hot(w2[0] - 1, TABLE - 1, dtype=jnp.float32,
+                              axis=0)
+        acc = add_selected(acc, w2[0], tuple(
+            const_dot(g_aff[c].T, oh_g) for c in range(2)))
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, infinity(batch), sel_seq)
+    return acc
+
+
 def _verify_core_impl(e, r, s, qx, qy, rn_lt_p,
                       ladder=shamir_ladder) -> jnp.ndarray:
     """Batched ECDSA-P256 verify on raw limb arrays.
@@ -347,6 +502,8 @@ def _verify_core_impl(e, r, s, qx, qy, rn_lt_p,
 
 
 verify_core = jax.jit(_verify_core_impl)
+verify_core_mixed = jax.jit(
+    functools.partial(_verify_core_impl, ladder=shamir_ladder_mixed))
 
 
 # --- Host wrapper ----------------------------------------------------------
@@ -435,7 +592,7 @@ def batch_verify(digests: np.ndarray, r_bytes: np.ndarray,
             arr = jax.device_put(arr, s)
         return arr
 
-    core = verify_core
+    core = verify_core_mixed if _use_mixed() else verify_core
     if _use_pallas() and mesh is None:
         # mesh path stays on the XLA core: GSPMD partitions that
         # program across chips, which it cannot do for the
@@ -452,6 +609,17 @@ def batch_verify(digests: np.ndarray, r_bytes: np.ndarray,
     if lazy:
         return lambda: np.asarray(ok) & range_ok
     return np.asarray(ok) & range_ok
+
+
+def _use_mixed() -> bool:
+    """FABRIC_MOD_TPU_MIXED_ADD=1 swaps the affine-table mixed-
+    addition ladder into the verify pipeline (shamir_ladder_mixed) —
+    dark-launched pending on-chip measurement, selectable per-run by
+    bench.py --mixed-add.  The Pallas path is routed AROUND it (the
+    kernel still implements the projective schedule): when both are
+    enabled Pallas wins, same as before."""
+    import os
+    return os.environ.get("FABRIC_MOD_TPU_MIXED_ADD", "") == "1"
 
 
 def _use_pallas() -> bool:

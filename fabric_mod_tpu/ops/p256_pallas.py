@@ -27,10 +27,13 @@ unrolled vreg lists, no dynamic sublane indexing):
   low-carry unrolls to static row indices
   (limbs9.set_unroll_low_carry, thread-local).
 
-The kernel is numerically IDENTICAL to the XLA ladder (same formulas,
-same order), differentially tested in interpret mode; flip it on in
-production with FABRIC_MOD_TPU_PALLAS=1 (bccsp/tpu.py) once on-chip
-measurement confirms the win.
+The kernel is numerically IDENTICAL to the XLA *projective* ladder
+(same formulas, same order), differentially tested in interpret mode;
+flip it on in production with FABRIC_MOD_TPU_PALLAS=1 (bccsp/tpu.py)
+once on-chip measurement confirms the win.  The affine-table MIXED
+ladder (p256.shamir_ladder_mixed, FABRIC_MOD_TPU_MIXED_ADD) is NOT
+ported here yet — batch_verify routes the Pallas path around it, so
+the two knobs compose: Pallas wins when both are set.
 """
 from __future__ import annotations
 
@@ -42,7 +45,7 @@ import numpy as np
 
 from fabric_mod_tpu.ops import limbs9 as limbs
 from fabric_mod_tpu.ops import p256
-from fabric_mod_tpu.ops.limbs9 import K, PRECISION
+from fabric_mod_tpu.ops.limbs9 import K
 from fabric_mod_tpu.ops.p256 import (
     N_WINDOWS, TABLE, _consts, _g_table, point_add, point_double)
 
@@ -119,7 +122,7 @@ def _ladder_kernel(sel1_ref, sel2_ref, qx_ref, qy_ref,
         gsel = tuple(
             jax.lax.dot_general(gt[c * K:(c + 1) * K], oh_g,
                                 (((1,), (0,)), ((), ())),
-                                precision=PRECISION)
+                                precision=limbs.PRECISION)
             for c in range(3))
         acc = point_add(acc, gsel, fp, b_m)
 

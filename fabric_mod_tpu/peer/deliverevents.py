@@ -177,6 +177,13 @@ class EventDeliverServer:
             seek = m.SeekInfo.decode(payload.data)
         except Exception:
             return m.Status.BAD_REQUEST, None
+        # Only DELIVER_SEEK_INFO envelopes are seek requests: any other
+        # well-signed envelope type decoding "successfully" as SeekInfo
+        # is an accident of the wire format, not a request (the
+        # reference's deliver handler validates the header type before
+        # the payload — deliver/deliver.go).
+        if ch.type != m.HeaderType.DELIVER_SEEK_INFO:
+            return m.Status.BAD_REQUEST, None
         if ch.channel_id != self._channel_id:
             return m.Status.NOT_FOUND, None
         resource = "event/FilteredBlock" if filtered else "event/Block"

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import stat
 import subprocess
 import sys
@@ -81,10 +82,12 @@ class CCaaSPlatform:
 class ScriptPlatform:
     """Generic script language: the payload is an executable script
     (shebang or python) launched as its own OS process; it must speak
-    the chaincode-server protocol and publish its listen address to
-    the path given in its run metadata — the same contract as an
-    external builder's bin/run (the reference's per-language build+
-    launch collapsed to one runnable artifact)."""
+    the chaincode-server protocol and publish its listen address —
+    NEWLINE-TERMINATED — to the path given in its run metadata (the
+    newline marks write completion; write-to-temp-then-rename also
+    works).  Same contract as an external builder's bin/run (the
+    reference's per-language build+launch collapsed to one runnable
+    artifact)."""
 
     name = "script"
 
@@ -92,8 +95,18 @@ class ScriptPlatform:
         return cc_type in ("script", "binary")
 
     def build(self, label: str, code: bytes, ctx: "LaunchContext"):
-        from fabric_mod_tpu.peer.extbuilder import ExternalContract
         work = tempfile.mkdtemp(prefix=f"ccscript-{label}-")
+        try:
+            return self._launch(label, code, ctx, work)
+        except BaseException:
+            # failed build: reap the workdir (nothing dials into it);
+            # on success it must persist — the script runs from it
+            shutil.rmtree(work, ignore_errors=True)
+            raise
+
+    def _launch(self, label: str, code: bytes, ctx: "LaunchContext",
+                work: str):
+        from fabric_mod_tpu.peer.extbuilder import ExternalContract
         script = os.path.join(work, "chaincode")
         with open(script, "wb") as f:
             f.write(code)
@@ -115,9 +128,17 @@ class ScriptPlatform:
         deadline = time.monotonic() + ctx.launch_timeout_s
         while time.monotonic() < deadline:
             if os.path.exists(addr_file):
-                addr = open(addr_file).read().strip()
-                if addr:
-                    return ExternalContract({"address": addr})
+                # The publish contract REQUIRES a newline-terminated
+                # address: existence of the file is not completion of
+                # the write (a non-atomic writer can be caught
+                # mid-write and we would dial a truncated address).
+                # Retry until the trailing newline lands.  NOTE this
+                # binds atomic-rename writers too — their content must
+                # also end with "\n" (the newline is the completion
+                # marker, rename or not).
+                raw = open(addr_file).read()
+                if raw.endswith("\n") and raw.strip():
+                    return ExternalContract({"address": raw.strip()})
             if proc.poll() is not None:
                 raise PlatformError(
                     f"package {label}: script exited rc="
@@ -126,7 +147,8 @@ class ScriptPlatform:
         proc.kill()
         proc.wait(timeout=5)
         raise PlatformError(
-            f"package {label}: script never published an address")
+            f"package {label}: script never published an address "
+            f"(the address file must be newline-terminated)")
 
 
 class LaunchContext:

@@ -27,14 +27,35 @@ MVCC and commit stay in the ledger (kvledger.commit_block).
 """
 from __future__ import annotations
 
+import functools
+
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from fabric_mod_tpu.observability.metrics import (MetricOpts,
+                                                  default_provider)
 from fabric_mod_tpu.policy import ApplicationPolicyEvaluator, BatchCollector
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
 from fabric_mod_tpu.protos.protoutil import SignedData
 
 V = m.TxValidationCode
+
+_STAGED_ITEMS_OPTS = MetricOpts(
+    "fabric", "validator", "staged_verify_items",
+    help="Unique verify items staged per block (the device batch size).")
+_DEDUP_SAVED_OPTS = MetricOpts(
+    "fabric", "validator", "dedup_saved_items",
+    help="Verify requests answered by within-block dedup instead of a "
+         "device lane (meta-policies and key-level candidates re-stage "
+         "identical signature sets).")
+
+
+@functools.lru_cache(maxsize=None)
+def _stage_metrics():
+    prov = default_provider()
+    return (prov.histogram(_STAGED_ITEMS_OPTS,
+                           buckets=(1, 8, 64, 256, 512, 1024, 2048)),
+            prov.counter(_DEDUP_SAVED_OPTS))
 
 
 class ValidationInfoProvider:
@@ -354,7 +375,15 @@ class TxValidator:
                 inblock_vp.setdefault((ns, key), []).append((idx, vp))
 
         # pass 2: dispatch the device batch (async when the verifier
-        # supports it; the resolver blocks only when called)
+        # supports it; the resolver blocks only when called).  Repeats
+        # across blocks — gossip redelivery, the endorsement/commit
+        # dual validation — are the verifier-level memo-cache's job
+        # (bccsp/tpu.VerdictCache); within-block repeats never reach
+        # it thanks to the collector's dedup, and both effects are
+        # exported so coalescing stays observable.
+        staged_hist, dedup_ctr = _stage_metrics()
+        staged_hist.observe(len(collector.items))
+        dedup_ctr.add(collector.requests - len(collector.items))
         async_fn = getattr(self._verifier, "verify_many_async", None)
         if async_fn is not None:
             mask_fn = async_fn(collector.items)

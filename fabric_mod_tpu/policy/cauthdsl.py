@@ -47,9 +47,12 @@ class BatchCollector:
 
     def __init__(self):
         self.items: List[VerifyItem] = []
-        self._index: dict = {}
+        self.requests = 0          # add() calls incl. dedup hits — the
+        self._index: dict = {}     # spread vs len(items) is staged work
+        #                            the dedup saved (validator metrics)
 
     def add(self, item: VerifyItem) -> int:
+        self.requests += 1
         key = (item.digest, item.signature, item.public_xy)
         got = self._index.get(key)
         if got is not None:

@@ -47,12 +47,22 @@ class OrderedLock:
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
         held = _held()
-        if held and held[-1][0] >= self.rank and held[-1][1] is not self:
-            raise RaceError(
-                f"lock-order violation: acquiring {self.name} "
-                f"(rank {self.rank}) while holding "
-                f"{held[-1][1].name} (rank {held[-1][0]}) — the "
-                f"hierarchy requires strictly increasing ranks")
+        # Re-entry of ANY already-held lock is always safe (RLock) and
+        # exempt from the rank rule — scan the whole held stack, not
+        # just its top: ledger(10) -> pvtstore(30) -> ledger(10) again
+        # cannot deadlock, and the checker runs live on production
+        # commit paths where a false positive would abort commits.
+        # Fresh locks still check against the HIGHEST held rank (not
+        # the stack top — after a re-entry the top can be a low rank
+        # that would mask a real inversion against a lock in between).
+        if held and not any(h[1] is self for h in held):
+            top_rank, top_lock = max(held, key=lambda h: h[0])
+            if top_rank >= self.rank:
+                raise RaceError(
+                    f"lock-order violation: acquiring {self.name} "
+                    f"(rank {self.rank}) while holding "
+                    f"{top_lock.name} (rank {top_rank}) — the "
+                    f"hierarchy requires strictly increasing ranks")
         ok = self._lock.acquire(blocking, timeout)
         if ok:
             held.append((self.rank, self))

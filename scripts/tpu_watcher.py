@@ -57,12 +57,21 @@ MATRIX = [
      {"FABRIC_MOD_TPU_PALLAS": "1"}, 900),
     ("verify_unroll", ["--metric", "verify"],
      {"FABRIC_MOD_TPU_UNROLL_LOW_CARRY": "1"}, 900),
-    ("verify_prec_high", ["--metric", "verify"],
-     {"FABRIC_MOD_TPU_PRECISION": "high"}, 900),
+    ("verify_prec_high", ["--metric", "verify", "--precision", "high"],
+     {}, 900),
+    ("verify_mixed_add", ["--metric", "verify", "--mixed-add", "1"],
+     {}, 900),
+    ("diffverify_mixed", ["--metric", "diffverify", "--batch", "10240"],
+     {}, 1200),
+    ("marshal", ["--metric", "marshal"], {}, 300),
     ("block", ["--metric", "block"], {}, 1200),
     ("e2e", ["--metric", "e2e"], {}, 1500),
     ("idemix", ["--metric", "idemix"], {}, 1500),
     ("gossip", ["--metric", "gossip"], {}, 900),
+    ("gossip_inflight1", ["--metric", "gossip", "--inflight", "1"],
+     {}, 900),
+    ("gossip_nocache", ["--metric", "gossip", "--memo-cache", "0"],
+     {}, 900),
 ]
 
 

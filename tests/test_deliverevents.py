@@ -16,7 +16,7 @@ from fabric_mod_tpu.msp.identities import SigningIdentity
 from fabric_mod_tpu.peer.aclmgmt import ACLProvider
 from fabric_mod_tpu.peer.deliverevents import (
     EventDeliverClient, EventDeliverServer, EventStreamError,
-    filtered_block)
+    filtered_block, make_signed_seek_envelope)
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
 
@@ -55,6 +55,30 @@ def test_filtered_stream_reports_validation_codes(world):
                 seen[ftx.txid] = ftx.tx_validation_code
     for txid in txids:
         assert seen[txid] == V.VALID
+
+
+def test_non_seek_envelope_rejected_bad_request(world):
+    """A WELL-SIGNED envelope whose channel header is not
+    DELIVER_SEEK_INFO must be refused with BAD_REQUEST — any other
+    type decoding as SeekInfo is a wire-format accident, not a seek
+    (ADVICE r5; reference: the deliver handler's header-type check)."""
+    net, server, _ = world
+    seek = m.SeekInfo(
+        start=m.SeekPosition(specified=m.SeekSpecified(number=0)),
+        stop=m.SeekPosition(specified=m.SeekSpecified(number=0)),
+        behavior=m.SeekBehavior.BLOCK_UNTIL_READY)
+    ch = protoutil.make_channel_header(
+        m.HeaderType.ENDORSER_TRANSACTION, net.channel_id)
+    sh = protoutil.make_signature_header(net.client.serialize(),
+                                         protoutil.new_nonce())
+    payload = protoutil.make_payload(ch, sh, seek.encode())
+    env = protoutil.sign_envelope(payload, net.client)
+    status, got = server._check_request(env.encode(), filtered=True)
+    assert status == m.Status.BAD_REQUEST and got is None
+    # control: the correctly-typed envelope still passes
+    good = make_signed_seek_envelope(net.channel_id, 0, 0, net.client)
+    status, got = server._check_request(good.encode(), filtered=True)
+    assert status == m.Status.SUCCESS and got is not None
 
 
 def test_wait_for_tx_learns_code_across_commit(world):

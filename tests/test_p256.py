@@ -126,29 +126,35 @@ def test_g_table_is_correct():
             assert limbs.limbs_to_int(tab[2][k]) == R % P
 
 
-# --- real signatures (cryptography / OpenSSL ground truth) ----------------
+# --- real signatures (sw-provider ground truth: OpenSSL when the
+# cryptography wheel is present, the pure-python fallback otherwise) --------
 
 def make_sigs(n_keys, n_sigs, rng):
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.hazmat.primitives.asymmetric.utils import (
-        decode_dss_signature, Prehashed)
-    from cryptography.hazmat.primitives import hashes
+    from fabric_mod_tpu.bccsp import sw
 
-    keys = [ec.generate_private_key(ec.SECP256R1()) for _ in range(n_keys)]
+    csp = sw.SwCSP()
+    keys = [csp.key_gen("P256") for _ in range(n_keys)]
     digests, rs, ss, qxs, qys = [], [], [], [], []
     for i in range(n_sigs):
         key = keys[i % n_keys]
         msg = bytes([i]) * 20 + rng.randbytes(12)
         d = hashlib.sha256(msg).digest()
-        der = key.sign(d, ec.ECDSA(Prehashed(hashes.SHA256())))
-        r, s = decode_dss_signature(der)
-        pub = key.public_key().public_numbers()
+        # raw (non-normalized) signing so high-S lanes stay reachable:
+        # the math-level tests below must see both halves of the order
+        der = key._priv.sign(d, _ecdsa_alg(key))
+        r, s = sw.decode_dss_signature(der)
+        xy = key.public_xy()
         digests.append(np.frombuffer(d, np.uint8))
         rs.append(np.frombuffer(r.to_bytes(32, "big"), np.uint8))
         ss.append(np.frombuffer(s.to_bytes(32, "big"), np.uint8))
-        qxs.append(np.frombuffer(pub.x.to_bytes(32, "big"), np.uint8))
-        qys.append(np.frombuffer(pub.y.to_bytes(32, "big"), np.uint8))
+        qxs.append(np.frombuffer(xy[:32], np.uint8))
+        qys.append(np.frombuffer(xy[32:], np.uint8))
     return tuple(np.stack(v) for v in (digests, rs, ss, qxs, qys))
+
+
+def _ecdsa_alg(key=None):
+    from fabric_mod_tpu.bccsp import sw
+    return sw.ec.ECDSA(sw.Prehashed(sw.hashes.SHA256()))
 
 
 @pytest.fixture(scope="module")
@@ -191,12 +197,11 @@ def test_high_s_is_mathematically_valid(sigbatch):
     assert ok.all()
 
 
-def test_agrees_with_openssl_on_random_tampering(sigbatch, rng):
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.hazmat.primitives.asymmetric.utils import (
-        encode_dss_signature, Prehashed)
-    from cryptography.hazmat.primitives import hashes
-    from cryptography.exceptions import InvalidSignature
+def test_agrees_with_sw_provider_on_random_tampering(sigbatch, rng):
+    """Per-lane verdicts vs the sw provider's scalar verify (OpenSSL
+    where available, the pure-python fallback otherwise) on random
+    byte-level tampering."""
+    from fabric_mod_tpu.bccsp import sw
 
     digests, rs, ss, qxs, qys = (v.copy() for v in sigbatch)
     # random byte-level tampering across all lanes; compare verdicts
@@ -208,15 +213,15 @@ def test_agrees_with_openssl_on_random_tampering(sigbatch, rng):
     for lane in range(len(digests)):
         r = int.from_bytes(bytes(rs[lane]), "big")
         s = int.from_bytes(bytes(ss[lane]), "big")
-        x = int.from_bytes(bytes(qxs[lane]), "big")
-        y = int.from_bytes(bytes(qys[lane]), "big")
-        pub = ec.EllipticCurvePublicNumbers(x, y, ec.SECP256R1()).public_key()
+        pub = sw.ec.EllipticCurvePublicKey.from_encoded_point(
+            sw.ec.SECP256R1(),
+            b"\x04" + bytes(qxs[lane]) + bytes(qys[lane]))
         try:
             if not (1 <= r < N and 1 <= s < N):
-                raise InvalidSignature()
-            pub.verify(encode_dss_signature(r, s), bytes(digests[lane]),
-                       ec.ECDSA(Prehashed(hashes.SHA256())))
+                raise sw.InvalidSignature()
+            pub.verify(sw.encode_dss_signature(r, s),
+                       bytes(digests[lane]), _ecdsa_alg(None))
             expect = True
-        except (InvalidSignature, ValueError):
+        except (sw.InvalidSignature, ValueError):
             expect = False
         assert bool(ours[lane]) == expect, f"lane {lane}"

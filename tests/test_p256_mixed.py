@@ -1,0 +1,154 @@
+"""Mixed-addition ladder vs the projective ladder — differentials.
+
+The affine-table ladder (ops/p256.shamir_ladder_mixed: RCB algorithm-5
+complete mixed adds over a Q table normalized by one Montgomery
+simultaneous inversion) must be indistinguishable from the original
+projective ladder at the affine-result level (the projective
+representatives legitimately differ by a Z scale) and verdict-
+identical through the verify core.  Edge cases the mixed formula must
+absorb: the infinity accumulator, zero windows (the affine tables have
+no infinity row — a keep-select covers them), P == Q (doubling through
+the complete add), and P == -Q (cancellation to infinity).
+"""
+import random
+
+import numpy as np
+import pytest
+
+from fabric_mod_tpu.ops import limbs9 as limbs, p256
+from fabric_mod_tpu.ops.limbs9 import FieldSpec, const_like, inv_mont_many
+
+P, N, GX, GY = p256.P, p256.N, p256.GX, p256.GY
+G = (GX, GY)
+R = 1 << limbs.RBITS
+
+
+# jax-free pure-python reference, independent of the ops code under
+# test (no third transcription of the affine formulas)
+from fabric_mod_tpu.bccsp._ecfallback import (point_add as ref_add,
+                                              point_mul as ref_mul)
+
+
+def to_proj_mont(pt):
+    if pt is None:
+        return (limbs.int_to_limbs(0), limbs.int_to_limbs(R % P),
+                limbs.int_to_limbs(0))
+    return (limbs.int_to_limbs(pt[0] * R % P),
+            limbs.int_to_limbs(pt[1] * R % P),
+            limbs.int_to_limbs(R % P))
+
+
+def from_proj_mont(xyz):
+    fp = FieldSpec.make("p256.p", P)
+    rinv = pow(R, -1, P)
+    X, Y, Z = (limbs.limbs_to_int(np.asarray(limbs.canonical(c, fp)))
+               * rinv % P for c in xyz)
+    if Z == 0:
+        return None
+    zi = pow(Z, -1, P)
+    return (X * zi % P, Y * zi % P)
+
+
+def test_point_add_mixed_matches_reference(rng):
+    """RCB alg. 5 vs the python-int affine reference, including the
+    completeness cases: generic, P == Q, inf + P, P + (-P)."""
+    import jax.numpy as jnp
+    fp, _, b_m, _, _ = p256._consts()
+    pts = [ref_mul(rng.randrange(1, N), G) for _ in range(6)]
+    cases = [(pts[0], pts[1]), (pts[2], pts[2]), (None, pts[3]),
+             (pts[4], (pts[4][0], P - pts[4][1])), (pts[5], G)]
+    a = tuple(jnp.stack([to_proj_mont(c[0])[i] for c in cases], axis=-1)
+              for i in range(3))
+    b = tuple(jnp.stack([to_proj_mont(c[1])[i] for c in cases], axis=-1)
+              for i in range(2))
+    out = p256.point_add_mixed(a, b, fp, const_like(b_m, a[0]))
+    for i, (u, v) in enumerate(cases):
+        got = from_proj_mont(
+            tuple(np.asarray(out[c][:, i]) for c in range(3)))
+        assert got == ref_add(u, v), f"case {i}"
+
+
+def test_inv_mont_many_matches_single_inversions(rng):
+    """Montgomery's simultaneous-inversion trick: same inverses as m
+    independent Fermat inversions, one lane poisoned by a zero."""
+    fp = FieldSpec.make("p256.p", P)
+    import jax.numpy as jnp
+    vals_int = [[rng.randrange(1, P) for _ in range(3)] for _ in range(5)]
+    vals_int[2][1] = 0                          # poison lane 1 only
+    vals = [limbs.to_device(np.stack(
+        [limbs.int_to_limbs(v * R % P) for v in row])) for row in vals_int]
+    got = inv_mont_many(vals, fp)
+    rinv = pow(R, -1, P)
+    for i, row in enumerate(vals_int):
+        for lane, v in enumerate(row):
+            g = limbs.limbs_to_int(
+                np.asarray(limbs.canonical(got[i][:, lane], fp))) \
+                * rinv % P
+            if any(r2[lane] == 0 for r2 in vals_int):
+                assert g == 0, "zero must poison its whole lane"
+            else:
+                assert g == pow(v, -1, P), (i, lane)
+
+
+def test_mixed_ladder_matches_projective(rng):
+    """Affine results of the two ladders agree on random windows plus
+    the zero-window edge lanes (all-zero -> infinity; u2-only zero)."""
+    import jax.numpy as jnp
+    batch = 3
+    qpts = [ref_mul(rng.randrange(2, 1000), G) for _ in range(batch)]
+    qx = limbs.to_device(np.stack(
+        [limbs.int_to_limbs(pt[0] * R % P) for pt in qpts]))
+    qy = limbs.to_device(np.stack(
+        [limbs.int_to_limbs(pt[1] * R % P) for pt in qpts]))
+    u1 = np.stack([[rng.randrange(p256.TABLE) for _ in range(batch)]
+                   for _ in range(p256.N_WINDOWS)]).astype(np.int32)
+    u2 = np.stack([[rng.randrange(p256.TABLE) for _ in range(batch)]
+                   for _ in range(p256.N_WINDOWS)]).astype(np.int32)
+    u1[:, 0] = 0                                 # lane 0: u1*G vanishes
+    u2[:, 0] = 0                                 # ... and u2*Q: infinity
+    u2[:, 1] = 0                                 # lane 1: G-adds only
+    want = p256.shamir_ladder(jnp.asarray(u1), jnp.asarray(u2), qx, qy)
+    got = p256.shamir_ladder_mixed(jnp.asarray(u1), jnp.asarray(u2),
+                                   qx, qy)
+    for lane in range(batch):
+        w = from_proj_mont(
+            tuple(np.asarray(want[c][:, lane]) for c in range(3)))
+        g = from_proj_mont(
+            tuple(np.asarray(got[c][:, lane]) for c in range(3)))
+        assert w == g, f"lane {lane}"
+    assert from_proj_mont(
+        tuple(np.asarray(got[c][:, 0]) for c in range(3))) is None
+
+
+@pytest.mark.slow
+def test_mixed_verify_core_verdicts_identical(rng):
+    """Full-core differential on real signatures including adversarial
+    lanes (tamper/zero-s/overrange-r/off-curve/high-s) — slow: the
+    mixed core is a fresh ~3min XLA compile on CPU."""
+    from fabric_mod_tpu.utils.fixtures import signature_arrays
+    d, r, s, qx, qy, expect = signature_arrays(8, tamper_last=True)
+    s = s.copy()
+    r = r.copy()
+    qy = qy.copy()
+    s[1][:] = 0
+    r[2][:] = np.frombuffer(N.to_bytes(32, "big"), np.uint8)
+    qy[3][31] ^= 1
+    s_int = int.from_bytes(bytes(s[4]), "big")
+    s[4] = np.frombuffer((N - s_int).to_bytes(32, "big"), np.uint8)
+    core_args, range_ok = p256.marshal_inputs(d, r, s, qx, qy)
+    proj = np.asarray(p256.verify_core(*core_args)) & range_ok
+    mixed = np.asarray(p256.verify_core_mixed(*core_args)) & range_ok
+    assert (proj == mixed).all()
+    # sanity on the untouched lanes
+    assert proj[0] and proj[5] and proj[6] and not proj[7]
+
+
+@pytest.mark.slow
+def test_mixed_differential_10k():
+    """The acceptance-scale differential (>= 10k randomized signatures
+    incl. invalid/edge lanes) via the bench harness — identical
+    verdicts required.  Hours-scale on CPU only because of signing;
+    run on the device platform via `bench.py --metric diffverify`."""
+    import bench
+    n, mismatches = bench.measure_diffverify(10240)
+    assert n >= 10240 and mismatches == 0

@@ -99,7 +99,7 @@ def test_script_platform_launches_and_dials(tmp_path):
         "srv = ChaincodeServer(KvContract())\n"
         "srv.start()\n"
         "with open(meta['address_file'] + '.tmp', 'w') as f:\n"
-        "    f.write(srv.address)\n"
+        "    f.write(srv.address + '\\n')\n"
         "os.replace(meta['address_file'] + '.tmp',\n"
         "           meta['address_file'])\n"
         "time.sleep(600)\n" % (str(__import__('pathlib').Path(
@@ -126,3 +126,57 @@ def test_script_platform_failure_is_launcher_shaped(tmp_path):
     launcher = ChaincodeLauncher(store)
     with pytest.raises(ExternalBuilderError, match="rc=3"):
         launcher.resolve("dies")
+
+
+def test_script_platform_waits_for_newline_terminated_address(tmp_path):
+    """A non-atomic writer caught mid-write must NOT yield a truncated
+    dial address: the build retries until the trailing newline lands
+    (ADVICE r5)."""
+    import glob
+    import os
+    script = (
+        "import json, sys, time\n"
+        "meta = json.load(open(sys.argv[1]))\n"
+        "f = open(meta['address_file'], 'w')\n"
+        "f.write('127.0.0.1:12')        # truncated prefix, no newline\n"
+        "f.flush()\n"
+        "time.sleep(0.5)\n"
+        "f.write('345\\n')              # write completes\n"
+        "f.flush()\n"
+        "time.sleep(600)\n"
+    ).encode()
+
+    class _Ctx:
+        launch_timeout_s = 10.0
+
+        def __init__(self):
+            self.procs = []
+
+        def track(self, p):
+            self.procs.append(p)
+
+    ctx = _Ctx()
+    try:
+        contract = ScriptPlatform().build("slowwrite", script, ctx)
+        assert contract._addr == ("127.0.0.1", 12345)
+    finally:
+        for p in ctx.procs:
+            p.kill()
+            p.wait(timeout=5)
+
+
+def test_script_platform_cleans_workdir_on_failure(tmp_path, monkeypatch):
+    """The mkdtemp workdir is reaped when the build fails (ADVICE r5) —
+    and kept when it succeeds (the script runs from it)."""
+    import glob
+    monkeypatch.setenv("TMPDIR", str(tmp_path))
+    import tempfile
+    tempfile.tempdir = None          # re-read TMPDIR
+    try:
+        ctx = LaunchContext(lambda p: None, launch_timeout_s=5.0)
+        with pytest.raises(PlatformError, match="rc=7"):
+            ScriptPlatform().build("boom", b"import sys; sys.exit(7)\n",
+                                   ctx)
+        assert glob.glob(str(tmp_path / "ccscript-boom-*")) == []
+    finally:
+        tempfile.tempdir = None

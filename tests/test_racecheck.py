@@ -54,6 +54,38 @@ def test_canary_lock_inversion_across_threads_bites():
     assert caught, "inverted acquisition was not detected"
 
 
+def test_reentry_of_held_lower_rank_lock_is_legal():
+    """Re-entry of ANY already-held lock is exempt from the rank rule,
+    even with higher-rank locks acquired in between: ledger(10) ->
+    pvtstore(30) -> ledger(10) again cannot deadlock (RLock), and a
+    false positive here would abort production commits (ADVICE r5)."""
+    ledger = OrderedLock(10, "ledger")
+    pvt = OrderedLock(30, "pvtstore")
+    with ledger:
+        with pvt:
+            with ledger:                  # re-entry below the top rank
+                pass
+        # stack unwound correctly: a fresh ordered pair still works
+        with pvt:
+            pass
+    # and the detector still bites for a DIFFERENT lower-rank lock
+    other = OrderedLock(10, "other")
+    with ledger:
+        with pvt:
+            with pytest.raises(RaceError, match="lock-order violation"):
+                other.acquire()
+    # re-entry must not blind the checker: after re-entering the low
+    # rank, a fresh mid-rank lock still inverts against the HIGHEST
+    # held rank (pvtstore 30), even though the stack top is rank 10
+    cache = OrderedLock(20, "cache")
+    with ledger:
+        with pvt:
+            with ledger:
+                with pytest.raises(RaceError,
+                                   match="lock-order violation"):
+                    cache.acquire()
+
+
 def test_canary_cross_thread_fsm_mutation_bites():
     own = ThreadOwnership("canary-fsm")
     own.claim()
