@@ -123,10 +123,19 @@ def measure_marshal(n: int, reps: int) -> tuple:
 
 
 def measure_diffverify(n: int) -> tuple:
-    """Differential acceptance check: the mixed-addition ladder core
-    must produce IDENTICAL verdicts to the projective core on n
-    randomized signatures including invalid and edge-case lanes.
-    Chunked through the 2048 bucket so each core compiles once."""
+    """Differential acceptance check: every enabled ladder core must
+    produce IDENTICAL verdicts to the projective XLA core on n
+    randomized signatures including invalid and edge-case lanes, and
+    the fused raw-message path must match host-side hashing.  Chunked
+    through one static bucket so each core compiles once.
+
+    Also times each core on the same chunks (interleaved min-of-k) —
+    the on-chip mixed-vs-projective A/B the ROADMAP's "measure before
+    defaulting on" question needs; the ratio lands in the JSON line.
+
+    Returns (n, mismatches, extras): mismatches totals across every
+    core pair INCLUDING the fused-hash differential.
+    """
     import numpy as np
 
     from fabric_mod_tpu.bccsp.tpu import marshal_items
@@ -135,8 +144,9 @@ def measure_diffverify(n: int) -> tuple:
     items, expect = make_items(n, n_keys=32)
     # the one tested marshalling path; copies because the edge-case
     # lanes below mutate the planes (fast-path outputs are read-only)
-    d, r, s, qx, qy, _pre_ok = (a.copy() if isinstance(a, np.ndarray)
-                                else a for a in marshal_items(items, n))
+    d, r, s, qx, qy, _pre_ok, _msg = (
+        a.copy() if isinstance(a, np.ndarray) else a
+        for a in marshal_items(items, n))
     # adversarial/edge lanes sprinkled across the batch (mirrors
     # tests/test_p256.py's negatives): tampered digest, wrong key,
     # zero/overrange scalars, off-curve key, (0,0) key, high-s mirror
@@ -155,27 +165,180 @@ def measure_diffverify(n: int) -> tuple:
             s[base + 7] = np.frombuffer(
                 (N_ORDER - s_int).to_bytes(32, "big"), np.uint8)
 
-    # pad to a whole number of 2048 chunks so each core compiles ONCE
-    # (a remainder chunk would mint a second multi-minute program
-    # shape); zero rows fail range_ok identically in both cores
-    pad = (-n) % 2048
+    # pad to a whole number of fixed-size chunks so each core compiles
+    # ONCE (a remainder chunk would mint a second multi-minute program
+    # shape); zero rows fail range_ok identically in every core.
+    # Small runs (the CPU smoke target) use one right-sized chunk.
+    chunk = 2048 if n >= 2048 else max(8, n + (-n) % 8)
+    pad = (-n) % chunk
     if pad:
         z = np.zeros((pad, 32), np.uint8)
         d, r, s = (np.concatenate([a, z]) for a in (d, r, s))
         qx, qy = (np.concatenate([a, z]) for a in (qx, qy))
 
+    # every core the env knobs can select, all compared against the
+    # projective XLA reference (PALLAS x MIXED_ADD composition matrix)
+    cores = {"projective": p256.verify_core,
+             "mixed": p256.verify_core_mixed}
+    if p256._use_pallas():
+        tile = next((t for t in (128, 64, 32, 16, 8)
+                     if chunk % t == 0), None)
+        if tile is not None:
+            cores["pallas_projective"] = p256._pallas_core(tile)
+            cores["pallas_mixed"] = p256._pallas_core(tile, mixed=True)
+
+    # warm-up: compile every core on the first chunk OUTSIDE the
+    # timing (a cold first call is a multi-minute XLA compile, which
+    # would otherwise dominate `best` whenever the batch is one chunk
+    # — i.e. exactly the A/B numbers the JSON line reports)
+    warm_args, _ = p256.marshal_inputs(
+        d[:chunk], r[:chunk], s[:chunk], qx[:chunk], qy[:chunk])
+    for name, core in cores.items():
+        t1 = time.perf_counter()
+        np.asarray(core(*warm_args))
+        log(f"{name}: warm-up (incl. compile) "
+            f"{time.perf_counter() - t1:.1f}s")
+
     mismatches = 0
+    best = {name: float("inf") for name in cores}
     t0 = time.perf_counter()
-    for lo in range(0, n + pad, 2048):
-        hi = lo + 2048
+    for lo in range(0, n + pad, chunk):
+        hi = lo + chunk
         core_args, range_ok = p256.marshal_inputs(
             d[lo:hi], r[lo:hi], s[lo:hi], qx[lo:hi], qy[lo:hi])
-        proj = np.asarray(p256.verify_core(*core_args)) & range_ok
-        mixed = np.asarray(p256.verify_core_mixed(*core_args)) & range_ok
-        mismatches += int((proj != mixed).sum())
-    log(f"diffverify: {n} signatures in {time.perf_counter() - t0:.1f}s, "
-        f"{mismatches} verdict mismatches")
-    return n, mismatches
+        got = {}
+        for name, core in cores.items():        # interleaved timing:
+            t1 = time.perf_counter()            # noisy neighbors hit
+            out = core(*core_args)              # all cores alike
+            verdicts = np.asarray(out) & range_ok
+            best[name] = min(best[name], time.perf_counter() - t1)
+            got[name] = verdicts
+        for name, verdicts in got.items():
+            if name != "projective":
+                mismatches += int((verdicts != got["projective"]).sum())
+    log(f"diffverify: {n} signatures x {len(cores)} cores in "
+        f"{time.perf_counter() - t0:.1f}s, {mismatches} verdict "
+        f"mismatches")
+    rates = {name: round(chunk / b, 1) for name, b in best.items()}
+    log(f"per-core best-chunk rates (verifies/s): {rates}")
+
+    fused_mm = _fused_hash_differential(min(n, 256))
+    mismatches += fused_mm
+    extras = {
+        "core_rates_verifies_per_sec": rates,
+        "mixed_vs_projective_speedup": round(
+            best["projective"] / best["mixed"], 3),
+        "fused_hash_mismatches": fused_mm,
+    }
+    if "pallas_mixed" in best:
+        extras["pallas_mixed_vs_projective_speedup"] = round(
+            best["projective"] / best["pallas_mixed"], 3)
+    return n, mismatches, extras
+
+
+def _fused_hash_differential(k: int) -> int:
+    """Raw-message items vs pre-digested items over the SAME payloads
+    and signatures (incl. tampered lanes) through TpuVerifier: the
+    fused on-device hash must change no verdict.  Returns mismatches."""
+    import hashlib
+
+    import numpy as np
+
+    from fabric_mod_tpu.bccsp.api import VerifyItem
+    from fabric_mod_tpu.bccsp.sw import SwCSP
+    from fabric_mod_tpu.bccsp.tpu import TpuVerifier
+
+    k = max(8, k + (-k) % 8)
+    csp = SwCSP()
+    keys = [csp.key_gen() for _ in range(4)]
+    raw, dig = [], []
+    for i in range(k):
+        m = b"fused-%d|" % i + b"x" * (i % 77)
+        kp = keys[i % len(keys)]
+        sig = csp.sign(kp, hashlib.sha256(m).digest())
+        if i % 9 == 5:
+            m += b"!"                      # tampered message lane
+        raw.append(VerifyItem(b"", sig, kp.public_xy(), message=m))
+        dig.append(VerifyItem(hashlib.sha256(m).digest(), sig,
+                              kp.public_xy()))
+    v = TpuVerifier(cache_size=0)
+    got_raw = np.asarray(v.verify_many(raw))
+    got_dig = np.asarray(v.verify_many(dig))
+    mm = int((got_raw != got_dig).sum())
+    log(f"fused-hash differential: {k} items, {mm} mismatches")
+    return mm
+
+
+def measure_hashverify(n: int, reps: int) -> tuple:
+    """Fused on-device hash->verify vs host-hash-then-device-verify,
+    same payloads/signatures through the same TpuVerifier front door.
+
+    The baseline pays the per-message host hashlib loop the fused path
+    deletes (the reference's hash-then-verify shape,
+    msp/identities.go:169); both paths' verdicts are asserted
+    identical, so the number can't come from a wrong-answer shortcut.
+    Messages are ~200-byte envelope-payload-sized."""
+    import hashlib
+
+    import numpy as np
+
+    from fabric_mod_tpu.bccsp.api import VerifyItem
+    from fabric_mod_tpu.bccsp.sw import SwCSP
+    from fabric_mod_tpu.bccsp.tpu import TpuVerifier
+
+    csp = SwCSP()
+    keys = [csp.key_gen() for _ in range(64)]
+    msgs, sigs, pubs, expect = [], [], [], []
+    log(f"hashverify: signing {n} messages ...")
+    for i in range(n):
+        m = (b"hashverify-%d|" % i) + b"p" * (150 + i % 100)
+        kp = keys[i % len(keys)]
+        sig = csp.sign(kp, hashlib.sha256(m).digest())
+        bad = i % 256 == 255
+        if bad:
+            m += b"!"                      # tampered message lane
+        msgs.append(m)
+        sigs.append(sig)
+        pubs.append(kp.public_xy())
+        expect.append(not bad)
+
+    raw_items = [VerifyItem(b"", sg, pb, message=m)
+                 for m, sg, pb in zip(msgs, sigs, pubs)]
+
+    def host_hash_pass():
+        return [VerifyItem(hashlib.sha256(m).digest(), sg, pb)
+                for m, sg, pb in zip(msgs, sigs, pubs)]
+
+    v = TpuVerifier(cache_size=0)
+    t0 = time.perf_counter()
+    got_dig = v.verify_many(host_hash_pass())
+    log(f"baseline warm-up (incl. compile): "
+        f"{time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    got_raw = v.verify_many(raw_items)
+    log(f"fused warm-up (incl. compile): {time.perf_counter() - t0:.1f}s")
+    if list(got_raw) != list(got_dig) or list(got_raw) != expect:
+        bad = [i for i, (a, b) in enumerate(zip(got_raw, got_dig))
+               if a != b]
+        raise AssertionError(
+            f"fused verdicts diverge from host hashing at {bad[:10]}")
+
+    # interleaved min-of-k (same reasoning as measure_marshal): the
+    # baseline re-hashes on the host every rep — that loop is exactly
+    # the cost under test
+    base_best = fused_best = float("inf")
+    for _ in range(max(reps, 3)):
+        t0 = time.perf_counter()
+        v.verify_many(host_hash_pass())
+        base_best = min(base_best, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        v.verify_many(raw_items)
+        fused_best = min(fused_best, time.perf_counter() - t0)
+    fused_rate = n / fused_best
+    base_rate = n / base_best
+    log(f"host-hash path: {base_rate:,.0f} verifies/s; fused: "
+        f"{fused_rate:,.0f} verifies/s ({fused_rate / base_rate:.2f}x)")
+    return fused_rate, base_rate
 
 
 def measure_sw(items, expect) -> float:
@@ -498,18 +661,32 @@ def run_worker(args) -> int:
         print(json.dumps(out))
         return 0
     if args.metric == "diffverify":
-        n, mismatches = measure_diffverify(args.batch)
+        n, mismatches, extras = measure_diffverify(args.batch)
         out = {
             "metric": "mixed_ladder_verdict_differential",
             "value": float(n),
             "unit": "signatures",
             "vs_baseline": 1.0 if mismatches == 0 else 0.0,
             "mismatches": mismatches,
+            **extras,
         }
         import jax
         out["platform"] = jax.devices()[0].platform
         print(json.dumps(out))
         return 0 if mismatches == 0 else 1
+    if args.metric == "hashverify":
+        fused_rate, base_rate = measure_hashverify(
+            args.batch, max(1, args.reps))
+        out = {
+            "metric": "fused_hashverify_verifies_per_sec",
+            "value": round(fused_rate, 1),
+            "unit": "verifies/s",
+            "vs_baseline": round(fused_rate / base_rate, 3),
+        }
+        import jax
+        out["platform"] = jax.devices()[0].platform
+        print(json.dumps(out))
+        return 0
     if args.metric == "block":
         dev_rate, sw_rate = measure_block(min(args.batch, 1000), args.reps)
         out = {
@@ -576,47 +753,73 @@ def run_worker(args) -> int:
 # Supervisor (parent): hard timeouts, retries, CPU fallback
 # ---------------------------------------------------------------------------
 
+def _run_bounded(cmd, env, timeout_s: float, stderr):
+    """subprocess.run with a timeout that actually BOUNDS: the child
+    gets its own process group and on expiry the WHOLE group is
+    SIGKILLed.  BENCH_r05 post-mortem: `subprocess.run(timeout=...)`
+    kills only the direct child, then blocks in communicate() until
+    every grandchild holding the stdout pipe exits — the TPU plugin's
+    tunnel helpers do exactly that, so the 180s probe "timeout" hung
+    far past 180s.  Returns (rc | None, stdout_bytes, note)."""
+    import signal
+
+    t0 = time.perf_counter()
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=stderr, start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out, f"in {time.perf_counter() - t0:.0f}s"
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            # bounded drain: the group is dead, the pipe must close;
+            # the belt-and-braces timeout guards a half-killed group
+            out, _ = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            out = b""
+        return None, out, f"hung >{timeout_s:.0f}s (process group killed)"
+
+
 def _preflight_probe(env, timeout_s: float):
     """Cheap TPU liveness probe in a throwaway child: just jax.devices().
 
     A hung axon tunnel used to cost the whole measurement budget
     (BENCH_r03 post-mortem: one 600s attempt, tunnel hung, round
     recorded the CPU fallback).  The probe bounds that discovery to
-    `timeout_s`: if the backend cannot even enumerate devices in that
-    window, the supervisor skips straight to the CPU fallback and the
-    budget is spent measuring, not waiting.
+    `timeout_s` — enforced by process-group kill (`_run_bounded`), not
+    subprocess.run's advisory timeout, which BENCH_r05 showed blowing
+    through 180s while tunnel grandchildren held the stdout pipe.  The
+    failure reason lands in the final JSON line ("preflight").
     """
     code = ("import jax, sys; d = jax.devices(); "
             "sys.stdout.write(d[0].platform)")
-    try:
-        proc = subprocess.run([sys.executable, "-c", code], env=env,
-                              timeout=timeout_s, stdout=subprocess.PIPE,
-                              stderr=subprocess.DEVNULL)
-    except subprocess.TimeoutExpired:
-        return None, f"probe hung >{timeout_s:.0f}s"
-    if proc.returncode != 0:
-        return None, f"probe rc={proc.returncode}"
-    platform = proc.stdout.decode().strip() or "unknown"
+    rc, out, note = _run_bounded([sys.executable, "-c", code], env,
+                                 timeout_s, subprocess.DEVNULL)
+    if rc is None:
+        return None, f"probe {note}"
+    if rc != 0:
+        return None, f"probe rc={rc}"
+    platform = out.decode().strip() or "unknown"
     return platform, f"probe ok: platform={platform}"
 
 
 def _spawn_worker(argv, env, timeout_s: float):
-    """Run this script with --_worker; return (json_dict | None, note)."""
+    """Run this script with --_worker; return (json_dict | None, note).
+    Same process-group-bounded supervision as the probe."""
     cmd = [sys.executable, os.path.abspath(__file__), "--_worker"] + argv
-    t0 = time.perf_counter()
-    try:
-        proc = subprocess.run(cmd, env=env, timeout=timeout_s,
-                              stdout=subprocess.PIPE, stderr=sys.stderr)
-    except subprocess.TimeoutExpired:
-        return None, f"worker timed out after {timeout_s:.0f}s"
-    dt = time.perf_counter() - t0
-    if proc.returncode != 0:
-        return None, f"worker rc={proc.returncode} after {dt:.0f}s"
-    for line in reversed(proc.stdout.decode().splitlines()):
+    rc, out, note = _run_bounded(cmd, env, timeout_s, sys.stderr)
+    if rc is None:
+        return None, f"worker {note}"
+    if rc != 0:
+        return None, f"worker rc={rc} {note}"
+    for line in reversed(out.decode().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line), f"ok in {dt:.0f}s"
+                return json.loads(line), f"ok {note}"
             except json.JSONDecodeError:
                 pass
     return None, "worker produced no JSON"
@@ -641,6 +844,7 @@ def supervise(args, argv) -> int:
                         os.path.expanduser("~/.cache/fabric_mod_tpu/jit"))
 
     note = "no TPU attempts configured"
+    pnote = None
     if not args.cpu:
         platform, pnote = _preflight_probe(base_env, probe_s)
         log(f"[bench] pre-flight: {pnote}")
@@ -653,6 +857,7 @@ def supervise(args, argv) -> int:
             result, note = _spawn_worker(argv, base_env, timeout_s)
             log(f"[bench] device attempt {attempt}: {note}")
             if result is not None:
+                result["preflight"] = pnote
                 print(json.dumps(result))
                 return 0
             if attempt < attempts:
@@ -681,6 +886,8 @@ def supervise(args, argv) -> int:
     log(f"[bench] cpu fallback: {note}")
     if result is not None:
         result["platform"] = "cpu"
+        if pnote is not None:
+            result["preflight"] = pnote
         if not args.cpu:
             result["note"] = diagnosis
         print(json.dumps(result))
@@ -688,7 +895,8 @@ def supervise(args, argv) -> int:
     # Even the CPU run failed — emit a parseable failure record.
     print(json.dumps({
         "metric": args.metric, "value": 0.0, "unit": "FAILED",
-        "vs_baseline": 0.0, "error": f"{diagnosis}; cpu fallback: {note}",
+        "vs_baseline": 0.0, "preflight": pnote,
+        "error": f"{diagnosis}; cpu fallback: {note}",
     }))
     return 1
 
@@ -697,10 +905,13 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=2048)
     ap.add_argument("--reps", type=int, default=3)
-    ap.add_argument("--metric",
+    ap.add_argument("--metric", action="append",
                     choices=("verify", "block", "e2e", "idemix", "gossip",
-                             "marshal", "diffverify"),
-                    default="verify")
+                             "marshal", "diffverify", "hashverify"),
+                    default=None,
+                    help="repeatable: each metric runs in sequence and "
+                         "prints its own JSON line (the smoke target "
+                         "passes --metric diffverify --metric hashverify)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend")
     # pipelined-front-end A/B knobs (see run_worker)
@@ -719,21 +930,27 @@ def main() -> int:
     ap.add_argument("--_worker", action="store_true",
                     help=argparse.SUPPRESS)
     args, _ = ap.parse_known_args()
+    metrics = args.metric or ["verify"]
 
     if args._worker:
+        args.metric = metrics[0]       # one metric per worker child
         return run_worker(args)
 
-    argv = ["--batch", str(args.batch), "--reps", str(args.reps),
-            "--metric", args.metric]
-    if args.mixed_add is not None:
-        argv += ["--mixed-add", str(args.mixed_add)]
-    if args.memo_cache is not None:
-        argv += ["--memo-cache", str(args.memo_cache)]
-    if args.inflight is not None:
-        argv += ["--inflight", str(args.inflight)]
-    if args.precision is not None:
-        argv += ["--precision", args.precision]
-    return supervise(args, argv)
+    rc = 0
+    for metric in metrics:
+        args.metric = metric
+        argv = ["--batch", str(args.batch), "--reps", str(args.reps),
+                "--metric", metric]
+        if args.mixed_add is not None:
+            argv += ["--mixed-add", str(args.mixed_add)]
+        if args.memo_cache is not None:
+            argv += ["--memo-cache", str(args.memo_cache)]
+        if args.inflight is not None:
+            argv += ["--inflight", str(args.inflight)]
+        if args.precision is not None:
+            argv += ["--precision", args.precision]
+        rc |= supervise(args, argv)
+    return rc
 
 
 if __name__ == "__main__":

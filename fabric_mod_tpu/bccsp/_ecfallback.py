@@ -9,16 +9,20 @@ P-256 ECDSA in python ints with exactly the micro-API surface sw.py
 touches — so `bccsp.sw` degrades to it transparently.
 
 Scope is deliberately tiny: P-256 keygen / deterministic-k (RFC 6979)
-sign / verify, uncompressed-point encode/decode, and DER
-ECDSA-Sig-Value encode/decode (decode shared with bccsp/der.py so the
-two parsers cannot drift).  P-384, PEM serialization, and AES raise
-with a clear "install cryptography" message instead of failing
-mysteriously.  Performance is ~ms per operation — fine for fixtures
-and baselines, never the production verify path (that is the device's
-job).
+sign / verify, uncompressed-point encode/decode, DER ECDSA-Sig-Value
+encode/decode (decode shared with bccsp/der.py so the two parsers
+cannot drift), and just enough key serialization for the self-
+generated material this framework mints: SEC1/PKCS#8 private keys and
+SubjectPublicKeyInfo public keys, PEM or DER (the surface
+msp/ca.py-issued certificates and the x509 fallback need — see
+bccsp/_x509fallback.py).  P-384 and AES raise with a clear "install
+cryptography" message instead of failing mysteriously.  Performance
+is ~ms per operation — fine for fixtures and baselines, never the
+production verify path (that is the device's job).
 """
 from __future__ import annotations
 
+import base64
 import hashlib
 import hmac
 import secrets
@@ -184,6 +188,173 @@ def decode_dss_signature(sig: bytes):
     return r, s
 
 
+# --- minimal DER primitives (shared with the x509 fallback) ----------------
+
+def der_tlv(tag: int, body: bytes) -> bytes:
+    """One DER TLV with a definite (short- or long-form) length."""
+    n = len(body)
+    if n < 0x80:
+        return bytes([tag, n]) + body
+    lb = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([tag, 0x80 | len(lb)]) + lb + body
+
+
+def der_seq(*parts: bytes) -> bytes:
+    return der_tlv(0x30, b"".join(parts))
+
+
+def der_int(v: int) -> bytes:
+    if v < 0:
+        raise ValueError("negative INTEGER")
+    body = v.to_bytes((v.bit_length() + 7) // 8 or 1, "big")
+    if body[0] & 0x80:
+        body = b"\x00" + body
+    return der_tlv(0x02, body)
+
+
+def der_oid(dotted: str) -> bytes:
+    arcs = [int(a) for a in dotted.split(".")]
+    body = bytearray([arcs[0] * 40 + arcs[1]])
+    for arc in arcs[2:]:
+        chunk = [arc & 0x7F]
+        arc >>= 7
+        while arc:
+            chunk.append(0x80 | (arc & 0x7F))
+            arc >>= 7
+        body.extend(reversed(chunk))
+    return der_tlv(0x06, bytes(body))
+
+
+class DerReader:
+    """Strict walking reader over one DER blob (controlled shapes —
+    everything this framework parses with it, it also generated)."""
+
+    def __init__(self, buf: bytes, start: int = 0, end: int = None):
+        self.buf = buf
+        self.off = start
+        self.end = len(buf) if end is None else end
+
+    def done(self) -> bool:
+        return self.off >= self.end
+
+    def peek_tag(self) -> int:
+        if self.done():
+            raise ValueError("truncated DER")
+        return self.buf[self.off]
+
+    def read(self, expect_tag: int = None):
+        """-> (tag, value_start, value_end); advances past the TLV."""
+        buf, off = self.buf, self.off
+        if off + 2 > self.end:
+            raise ValueError("truncated DER")
+        tag = buf[off]
+        if expect_tag is not None and tag != expect_tag:
+            raise ValueError(
+                f"DER tag 0x{tag:02x}, expected 0x{expect_tag:02x}")
+        ln = buf[off + 1]
+        off += 2
+        if ln & 0x80:
+            nb = ln & 0x7F
+            if nb == 0 or nb > 4 or off + nb > self.end:
+                raise ValueError("bad DER length")
+            ln = int.from_bytes(buf[off:off + nb], "big")
+            off += nb
+        if off + ln > self.end:
+            raise ValueError("DER value overruns buffer")
+        self.off = off + ln
+        return tag, off, off + ln
+
+    def value(self, expect_tag: int = None) -> bytes:
+        _, a, b = self.read(expect_tag)
+        return self.buf[a:b]
+
+    def reader(self, expect_tag: int = None) -> "DerReader":
+        _, a, b = self.read(expect_tag)
+        return DerReader(self.buf, a, b)
+
+
+# OIDs for the EC key/cert surface
+OID_EC_PUBLIC_KEY = "1.2.840.10045.2.1"
+OID_PRIME256V1 = "1.2.840.10045.3.1.7"
+OID_ECDSA_SHA256 = "1.2.840.10045.4.3.2"
+
+_EC_ALG_ID = der_seq(der_oid(OID_EC_PUBLIC_KEY), der_oid(OID_PRIME256V1))
+
+
+def pem_encode(label: str, der: bytes) -> bytes:
+    b64 = base64.b64encode(der)
+    lines = [b64[i:i + 64] for i in range(0, len(b64), 64)]
+    return (b"-----BEGIN %s-----\n" % label.encode()
+            + b"\n".join(lines)
+            + b"\n-----END %s-----\n" % label.encode())
+
+
+def pem_decode(data: bytes) -> bytes:
+    """First PEM block -> DER bytes (label-agnostic on purpose: the
+    callers dispatch on content, mirroring cryptography's loaders)."""
+    lines = data.replace(b"\r", b"").split(b"\n")
+    body, inside = [], False
+    for ln in lines:
+        if ln.startswith(b"-----BEGIN"):
+            inside = True
+            continue
+        if ln.startswith(b"-----END"):
+            break
+        if inside:
+            body.append(ln.strip())
+    if not inside or not body:
+        raise ValueError("no PEM block found")
+    return base64.b64decode(b"".join(body))
+
+
+def spki_der(x: int, y: int) -> bytes:
+    """SubjectPublicKeyInfo DER for an uncompressed P-256 point."""
+    point = b"\x04" + x.to_bytes(32, "big") + y.to_bytes(32, "big")
+    return der_seq(_EC_ALG_ID, der_tlv(0x03, b"\x00" + point))
+
+
+def parse_spki(der: bytes) -> "EllipticCurvePublicKey":
+    outer = DerReader(der).reader(0x30)
+    alg = outer.reader(0x30)
+    if alg.value(0x06) != der_oid(OID_EC_PUBLIC_KEY)[2:]:
+        raise UnsupportedByFallback("non-EC SubjectPublicKeyInfo")
+    if alg.value(0x06) != der_oid(OID_PRIME256V1)[2:]:
+        raise UnsupportedByFallback("non-P256 SubjectPublicKeyInfo")
+    bits = outer.value(0x03)
+    if len(bits) != 66 or bits[0] != 0 or bits[1] != 0x04:
+        raise ValueError("bad EC point BIT STRING")
+    return EllipticCurvePublicKey(int.from_bytes(bits[2:34], "big"),
+                                  int.from_bytes(bits[34:], "big"))
+
+
+def pkcs8_der(d: int) -> bytes:
+    """PKCS#8 (unencrypted) DER for a P-256 private scalar, embedding
+    the RFC 5915 ECPrivateKey with the public point."""
+    x, y = point_mul(d, (GX, GY))
+    point = b"\x04" + x.to_bytes(32, "big") + y.to_bytes(32, "big")
+    ecpriv = der_seq(
+        der_int(1),
+        der_tlv(0x04, d.to_bytes(32, "big")),
+        der_tlv(0xA1, der_tlv(0x03, b"\x00" + point)))
+    return der_seq(der_int(0), _EC_ALG_ID, der_tlv(0x04, ecpriv))
+
+
+def parse_pkcs8(der: bytes) -> "EllipticCurvePrivateKey":
+    outer = DerReader(der).reader(0x30)
+    if outer.value(0x02) != b"\x00":
+        raise ValueError("unsupported PKCS#8 version")
+    alg = outer.reader(0x30)
+    if alg.value(0x06) != der_oid(OID_EC_PUBLIC_KEY)[2:]:
+        raise UnsupportedByFallback("non-EC private key")
+    ecpriv = DerReader(outer.value(0x04)).reader(0x30)
+    if ecpriv.value(0x02) != b"\x01":
+        raise ValueError("unsupported ECPrivateKey version")
+    d = int.from_bytes(ecpriv.value(0x04), "big")
+    if not 1 <= d < N:
+        raise ValueError("private scalar out of range")
+    return EllipticCurvePrivateKey(d)
+
+
 # --- RFC 6979 deterministic nonce ------------------------------------------
 
 def _rfc6979_k(d: int, e: int) -> int:
@@ -227,6 +398,20 @@ class Prehashed:
         self.algorithm = algorithm
 
 
+def _digest_for_alg(data: bytes, alg) -> bytes:
+    """Resolve the sign/verify input per the cryptography contract:
+    ECDSA(Prehashed(...)) passes `data` through as the digest,
+    ECDSA(SHA256()) (the x509 cert-signing path) hashes it.  No alg
+    (legacy internal callers) means pre-hashed."""
+    inner = getattr(alg, "algorithm", None)
+    if inner is None or isinstance(inner, Prehashed):
+        return data[:32]
+    name = getattr(inner, "name", "sha256")
+    if name != "sha256":
+        raise UnsupportedByFallback(f"{name} message digests")
+    return hashlib.sha256(data).digest()
+
+
 class EllipticCurvePublicNumbers:
     def __init__(self, x: int, y: int, curve=None):
         self.x = x
@@ -257,17 +442,24 @@ class EllipticCurvePublicKey:
         return EllipticCurvePublicNumbers(self._x, self._y)
 
     def public_bytes(self, encoding=None, fmt=None) -> bytes:
+        if encoding == "PEM":
+            return pem_encode("PUBLIC KEY", spki_der(self._x, self._y))
+        if encoding == "DER":
+            return spki_der(self._x, self._y)
         return (b"\x04" + self._x.to_bytes(32, "big")
                 + self._y.to_bytes(32, "big"))
 
-    def verify(self, signature: bytes, digest: bytes, alg=None) -> None:
+    def verify(self, signature: bytes, data: bytes, alg=None) -> None:
+        """`data` is the raw message unless alg wraps Prehashed (the
+        cryptography contract: ECDSA(SHA256()) hashes, Prehashed
+        passes the digest through)."""
         try:
             r, s = decode_dss_signature(signature)
         except ValueError:
             raise InvalidSignature("bad DER")
         if not (1 <= r < N and 1 <= s < N):
             raise InvalidSignature("scalar out of range")
-        e = int.from_bytes(digest[:32], "big")
+        e = int.from_bytes(_digest_for_alg(data, alg), "big")
         w = pow(s, -1, N)
         pt = point_add(point_mul(e * w % N, (GX, GY)),
                        point_mul(r * w % N, (self._x, self._y)))
@@ -288,8 +480,8 @@ class EllipticCurvePrivateKey:
             self._pub = EllipticCurvePublicKey(x, y)
         return self._pub
 
-    def sign(self, digest: bytes, alg=None) -> bytes:
-        e = int.from_bytes(digest[:32], "big")
+    def sign(self, data: bytes, alg=None) -> bytes:
+        e = int.from_bytes(_digest_for_alg(data, alg), "big")
         d = self._d
         k = _rfc6979_k(d, e)
         while True:
@@ -300,8 +492,12 @@ class EllipticCurvePrivateKey:
                 return encode_dss_signature(r, s)
             k = (k + 1) % N or 1        # astronomically unlikely
 
-    def private_bytes(self, *a, **kw):
-        raise UnsupportedByFallback("PEM private-key serialization")
+    def private_bytes(self, encoding=None, fmt=None,
+                      encryption=None) -> bytes:
+        der = pkcs8_der(self._d)
+        if encoding == "DER":
+            return der
+        return pem_encode("PRIVATE KEY", der)
 
 
 def generate_private_key(curve) -> EllipticCurvePrivateKey:
@@ -348,10 +544,35 @@ class _Raiser:
         raise UnsupportedByFallback(self._what)
 
 
+def load_pem_private_key(data: bytes, password=None):
+    if password is not None:
+        raise UnsupportedByFallback("encrypted private keys")
+    return parse_pkcs8(pem_decode(data))
+
+
+def load_pem_public_key(data: bytes):
+    return parse_spki(pem_decode(data))
+
+
+def load_der_private_key(data: bytes, password=None):
+    if password is not None:
+        raise UnsupportedByFallback("encrypted private keys")
+    return parse_pkcs8(data)
+
+
+def load_der_public_key(data: bytes):
+    return parse_spki(data)
+
+
+class NoEncryption:
+    pass
+
+
 class _SerializationNamespace:
     class Encoding:
         X962 = "X962"
         PEM = "PEM"
+        DER = "DER"
 
     class PublicFormat:
         UncompressedPoint = "UncompressedPoint"
@@ -360,9 +581,11 @@ class _SerializationNamespace:
     class PrivateFormat:
         PKCS8 = "PKCS8"
 
-    NoEncryption = _Raiser("serialization.NoEncryption")
-    load_pem_private_key = _Raiser("serialization.load_pem_private_key")
-    load_pem_public_key = _Raiser("serialization.load_pem_public_key")
+    NoEncryption = NoEncryption
+    load_pem_private_key = staticmethod(load_pem_private_key)
+    load_pem_public_key = staticmethod(load_pem_public_key)
+    load_der_private_key = staticmethod(load_der_private_key)
+    load_der_public_key = staticmethod(load_der_public_key)
 
 
 ec = _EcNamespace()

@@ -31,13 +31,21 @@ class VerifyItem:
     """One signature-verification work item (the batch element).
 
     digest: 32-byte message digest (pre-hashed, like the reference's
-      Verify(k, signature, digest) contract).
+      Verify(k, signature, digest) contract).  Ignored (use b"") when
+      `message` is set.
     signature: DER-encoded ECDSA signature.
     public_xy: 64 bytes — uncompressed P-256 point coordinates (x‖y).
+    message: optional RAW message bytes.  When set, the provider
+      computes e = SHA-256(message) itself — the TPU provider fuses
+      that hash into the same device program as the verify
+      (FABRIC_MOD_TPU_FUSED_HASH; ops/p256.batch_verify_raw), host
+      providers hash in software.  Raw and pre-digested items mix
+      freely in one batch.
     """
     digest: bytes
     signature: bytes
     public_xy: bytes
+    message: Optional[bytes] = None
 
 
 class Key(abc.ABC):
@@ -87,13 +95,17 @@ class BCCSP(abc.ABC):
 
         A malformed item (bad point encoding, junk DER) yields False
         for that item only — batch-poisoning is never acceptable on
-        the commit path.
+        the commit path.  Raw-message items are hashed here (host
+        software) — device providers override with the fused path.
         """
         out = []
         for it in items:
             try:
                 key = self.key_import(b"\x04" + it.public_xy, "P256-pub")
-                out.append(self.verify(key, it.signature, it.digest))
+                digest = it.digest
+                if getattr(it, "message", None) is not None:
+                    digest = self.hash(it.message)
+                out.append(self.verify(key, it.signature, digest))
             except Exception:
                 out.append(False)
         return out

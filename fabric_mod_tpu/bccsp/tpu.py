@@ -108,9 +108,14 @@ def marshal_items(items: Sequence[VerifyItem], size: Optional[int] = None
     (per-item DER decode, int.to_bytes, np.frombuffer): one batched
     DER parse, one packed copy per fixed-width field, and ONE low-S /
     length range check across the whole batch.  Returns
-    (d, r, s, qx, qy, pre_ok) — five (size, 32) uint8 planes padded to
-    the bucket `size` plus the (size,) host-side validity mask (False
-    rows never contribute a True verdict, whatever the device says).
+    (d, r, s, qx, qy, pre_ok, msg) — five (size, 32) uint8 planes
+    padded to the bucket `size`, the (size,) host-side validity mask
+    (False rows never contribute a True verdict, whatever the device
+    says), and the fused-hash MESSAGE lane: None when no item carries
+    a raw message, else (words, nblocks, has_msg) from the vectorized
+    padder (der.pack_messages) — raw rows get their digest computed
+    ON DEVICE (p256.batch_verify_raw), pre-digested rows keep the
+    digest plane, one program either way.
 
     Fresh output arrays each call on purpose: jax's host->device
     transfer of a dispatched-but-unresolved batch may still be reading
@@ -119,6 +124,8 @@ def marshal_items(items: Sequence[VerifyItem], size: Optional[int] = None
     """
     n = len(items)
     size = n if size is None else size
+    msgs = [getattr(it, "message", None) for it in items]
+    any_raw = any(m is not None for m in msgs)
     d, d_ok = _der.pack_fixed(
         list(map(operator.attrgetter("digest"), items)), 32, size)
     pub, pub_ok = _der.pack_fixed(
@@ -126,10 +133,23 @@ def marshal_items(items: Sequence[VerifyItem], size: Optional[int] = None
     r, s, der_ok = _der.decode_der_batch(
         list(map(operator.attrgetter("signature"), items)), size)
     low_s = _der.lt_bytes(s, _LOW_S_BOUND)           # the low-S rule
+    msg = None
+    if any_raw:
+        words, nblocks, msg_ok = _der.pack_messages(
+            [m if m is not None else b"" for m in msgs], size,
+            round_blocks_pow2=True)
+        has_msg = np.zeros(size, bool)
+        has_msg[:n] = [m is not None for m in msgs]
+        # raw rows validate on the message, not the (empty) digest;
+        # a raw item whose message is not bytes stays invalid rather
+        # than silently falling back to a digest it did not carry
+        d_ok = np.where(has_msg, msg_ok, d_ok)
+        nblocks = np.where(has_msg, nblocks, 0).astype(np.int32)
+        msg = (words, nblocks, has_msg)
     pre_ok = d_ok & pub_ok & der_ok & low_s
     qx = np.ascontiguousarray(pub[:, :32])
     qy = np.ascontiguousarray(pub[:, 32:])
-    return d, r, s, qx, qy, pre_ok
+    return d, r, s, qx, qy, pre_ok, msg
 
 
 # ---------------------------------------------------------------------------
@@ -181,7 +201,9 @@ class VerdictCache:
     def key_of(item: VerifyItem) -> Optional[tuple]:
         """Hashable memo key, or None for items with non-bytes fields
         (bytearray coerces; anything else is uncacheable and must not
-        raise — one weird item may never poison a coalesced batch)."""
+        raise — one weird item may never poison a coalesced batch).
+        Raw-message items key on the message too: (digest, sig, key,
+        message) is the full pure-function input of the fused path."""
         key = []
         for x in (item.digest, item.signature, item.public_xy):
             if type(x) is not bytes:
@@ -189,6 +211,12 @@ class VerdictCache:
                     return None
                 x = bytes(x)
             key.append(x)
+        msg = getattr(item, "message", None)
+        if msg is not None and type(msg) is not bytes:
+            if not isinstance(msg, (bytes, bytearray, memoryview)):
+                return None
+            msg = bytes(msg)
+        key.append(msg)
         return tuple(key)
 
     def get_many(self, keys: Sequence[Optional[tuple]]
@@ -340,10 +368,19 @@ class TpuVerifier:
                      for i in range(0, n, BUCKETS[-1])]
             return lambda: np.concatenate([p() for p in parts])
         size = _bucket(n, self._mesh_size)
-        d, r, s, qx, qy, pre_ok = marshal_items(items, size)
+        d, r, s, qx, qy, pre_ok, msg = marshal_items(items, size)
         from fabric_mod_tpu.ops import p256
-        resolve = p256.batch_verify(d, r, s, qx, qy, mesh=self._mesh,
-                                    lazy=True)
+        if msg is not None:
+            # fused hash->verify: raw-message lanes hash on device in
+            # the SAME program as the ladder — one dispatch, no host
+            # digest loop (FABRIC_MOD_TPU_FUSED_HASH consumers)
+            words, nblocks, has_msg = msg
+            resolve = p256.batch_verify_raw(
+                words, nblocks, has_msg, d, r, s, qx, qy,
+                mesh=self._mesh, lazy=True)
+        else:
+            resolve = p256.batch_verify(d, r, s, qx, qy,
+                                        mesh=self._mesh, lazy=True)
         return lambda: (resolve() & pre_ok)[:n]
 
 

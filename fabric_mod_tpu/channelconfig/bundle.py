@@ -22,7 +22,12 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
-from cryptography import x509
+try:
+    from cryptography import x509
+except ImportError:
+    # Wheel-less container: minimal DER x509 fallback (see
+    # bccsp/_x509fallback.py; bccsp/sw.py logged the downgrade).
+    from fabric_mod_tpu.bccsp import _x509fallback as x509
 
 from fabric_mod_tpu.msp.mspimpl import Msp, MspManager, NodeOUs
 from fabric_mod_tpu.policy.cauthdsl import CompiledPolicy, PolicyError

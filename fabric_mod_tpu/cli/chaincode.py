@@ -20,7 +20,10 @@ import sys
 
 
 def _load_identity(crypto_dir: str, org: str, kind: str, name: str):
-    from cryptography import x509
+    try:
+        from cryptography import x509
+    except ImportError:       # wheel-less: bccsp/_x509fallback.py
+        from fabric_mod_tpu.bccsp import _x509fallback as x509
 
     from fabric_mod_tpu.bccsp.sw import SwCSP
     from fabric_mod_tpu.msp.identities import SigningIdentity

@@ -50,7 +50,10 @@ log = get_logger("node")
 
 
 def _load_signer(crypto_dir: str, org: str, kind: str, csp):
-    from cryptography import x509
+    try:
+        from cryptography import x509
+    except ImportError:       # wheel-less: bccsp/_x509fallback.py
+        from fabric_mod_tpu.bccsp import _x509fallback as x509
     base = os.path.join(crypto_dir, org)
     cert_path = os.path.join(base, f"{kind}s", f"{kind}0.pem")
     key_path = os.path.join(base, f"{kind}s", f"{kind}0.key")

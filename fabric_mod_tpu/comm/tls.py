@@ -15,9 +15,16 @@ import ipaddress
 import os
 from typing import Callable, List, Optional, Sequence, Tuple
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+except ImportError:
+    # Wheel-less container: minimal DER x509 fallback (see
+    # bccsp/_x509fallback.py; bccsp/sw.py logged the downgrade).
+    from fabric_mod_tpu.bccsp import _x509fallback as x509
+    from fabric_mod_tpu.bccsp._ecfallback import (ec, hashes,
+                                                  serialization)
 
 from fabric_mod_tpu.msp import ca as calib
 

@@ -48,10 +48,15 @@ def _pem_cert_der_hash(pem: bytes) -> bytes:
     (PEM wrapping differs between the client's file and the server's
     re-encoded auth_context view)."""
     import hashlib as _hl
-    from cryptography import x509
-    from cryptography.hazmat.primitives.serialization import Encoding
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives.serialization import Encoding
+        der_enc = Encoding.DER
+    except ImportError:       # wheel-less: bccsp/_x509fallback.py
+        from fabric_mod_tpu.bccsp import _x509fallback as x509
+        der_enc = "DER"
     cert = x509.load_pem_x509_certificate(pem)
-    return _hl.sha256(cert.public_bytes(Encoding.DER)).digest()
+    return _hl.sha256(cert.public_bytes(der_enc)).digest()
 
 
 class InProcNetwork:

@@ -27,10 +27,17 @@ import hashlib
 import json
 from typing import List, Optional, Sequence
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import Prehashed
-from cryptography.exceptions import InvalidSignature
+try:
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import Prehashed
+    from cryptography.exceptions import InvalidSignature
+except ImportError:
+    # Wheel-less container: pure-python P-256 fallback (see
+    # bccsp/_ecfallback.py; bccsp/sw.py logged the downgrade).
+    from fabric_mod_tpu.bccsp._ecfallback import (InvalidSignature,
+                                                  Prehashed, ec, hashes,
+                                                  serialization)
 
 
 def rh_digest(rh: int) -> str:

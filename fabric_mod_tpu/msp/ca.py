@@ -10,10 +10,19 @@ from __future__ import annotations
 import datetime
 from typing import Optional
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+except ImportError:
+    # Wheel-less container: the minimal DER x509 fallback (issue/parse
+    # of our self-generated cert shapes — bccsp/_x509fallback.py; the
+    # bccsp/sw.py import gate already logged the downgrade).
+    from fabric_mod_tpu.bccsp import _x509fallback as x509
+    from fabric_mod_tpu.bccsp._ecfallback import (ec, hashes,
+                                                  serialization)
+    NameOID = x509.NameOID
 
 
 def _name(cn: str, org: Optional[str] = None, ou: Optional[list] = None):

@@ -9,14 +9,31 @@ check as a VerifyItem so callers can batch instead.
 from __future__ import annotations
 
 import hashlib
+import os
 from typing import Optional
 
-from cryptography import x509
-from cryptography.hazmat.primitives import serialization
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import serialization
+except ImportError:
+    # Wheel-less container: minimal DER x509 fallback (see
+    # bccsp/_x509fallback.py; bccsp/sw.py logged the downgrade).
+    from fabric_mod_tpu.bccsp import _x509fallback as x509
+    from fabric_mod_tpu.bccsp._ecfallback import serialization
 
 from fabric_mod_tpu.bccsp.api import BCCSP, VerifyItem
 from fabric_mod_tpu.bccsp import sw as swlib
 from fabric_mod_tpu.protos import messages as m
+
+
+def fused_hash_enabled() -> bool:
+    """FABRIC_MOD_TPU_FUSED_HASH=1 moves the e = H(m) of batched
+    verifies onto the device: `verify_item` emits raw-MESSAGE items
+    and the TPU provider hashes them in the same jitted program as the
+    ECDSA verify (ops/p256.batch_verify_raw) — no host digest loop on
+    the block-commit path.  Read per call on purpose (cheap), so tests
+    and bench A/B can flip it without rebuilding identities."""
+    return os.environ.get("FABRIC_MOD_TPU_FUSED_HASH", "") == "1"
 
 
 class Identity:
@@ -63,9 +80,19 @@ class Identity:
         return self._csp.verify(self._key, sig, self.digest_for(msg))
 
     def verify_item(self, msg: bytes, sig: bytes) -> Optional[VerifyItem]:
-        """The same check as a batchable work item (P-256 only)."""
+        """The same check as a batchable work item (P-256 only).
+
+        Under FABRIC_MOD_TPU_FUSED_HASH the item carries the RAW
+        message instead of a host-computed digest — the TPU provider
+        then computes e = H(m) on device inside the verify program
+        (one dispatch for hash + ladder), which removes this method
+        from the per-message hashlib loop the reference's
+        hash-then-verify shape implies (msp/identities.go:169)."""
         if self._key.curve != "P256":
             return None
+        if fused_hash_enabled():
+            return VerifyItem(b"", sig, self._key.public_xy(),
+                              message=msg)
         return VerifyItem(self.digest_for(msg), sig, self._key.public_xy())
 
 

@@ -15,9 +15,19 @@ from __future__ import annotations
 import datetime
 from typing import Dict, List, Optional, Sequence
 
-from cryptography import x509
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric import ec, padding as _pad
+try:
+    from cryptography import x509
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric import (ec,
+                                                           padding as _pad)
+except ImportError:
+    # Wheel-less container: minimal DER x509 fallback (see
+    # bccsp/_x509fallback.py; bccsp/sw.py logged the downgrade).  RSA
+    # chain links cannot occur there — our CA lib only mints EC certs.
+    from fabric_mod_tpu.bccsp import _x509fallback as x509
+    from fabric_mod_tpu.bccsp._ecfallback import InvalidSignature, ec
+    from fabric_mod_tpu.bccsp._ecfallback import _Raiser
+    _pad = _Raiser("RSA padding")
 
 from fabric_mod_tpu.bccsp.api import BCCSP
 from fabric_mod_tpu.msp.identities import (

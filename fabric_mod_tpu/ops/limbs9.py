@@ -518,7 +518,7 @@ def inv_mont(a_mont: jnp.ndarray, spec: FieldSpec) -> jnp.ndarray:
     return pow_static(a_mont, spec.modulus - 2, spec)
 
 
-def inv_mont_many(vals, spec: FieldSpec) -> list:
+def inv_mont_many(vals, spec: FieldSpec, inv=None) -> list:
     """Montgomery's simultaneous-inversion trick: invert m Montgomery-
     domain values with ONE Fermat inversion plus 3(m-1) multiplies.
 
@@ -530,16 +530,23 @@ def inv_mont_many(vals, spec: FieldSpec) -> list:
     products) — callers rely on such lanes being masked out anyway
     (an on-curve point of a prime-order curve never has Z = 0 in the
     window table; only invalid keys do, and key_ok masks those).
+
+    `inv` overrides the single Fermat inversion (default `inv_mont`,
+    the generic square-and-multiply scan).  Pallas kernels pass a
+    scan-free addition chain (ops/p256.inv_mont_p_chain): a lax.scan
+    over a captured (256,) constant bit array is exactly the kind of
+    trace Mosaic rejects.
     """
+    inv = inv or inv_mont
     m = len(vals)
     if m == 0:
         return []
     if m == 1:
-        return [inv_mont(vals[0], spec)]
+        return [inv(vals[0], spec)]
     prefix = [vals[0]]
     for v in vals[1:]:
         prefix.append(mont_mul(prefix[-1], v, spec))
-    running = inv_mont(prefix[-1], spec)     # (v_0 * ... * v_{m-1})^-1
+    running = inv(prefix[-1], spec)          # (v_0 * ... * v_{m-1})^-1
     out = [None] * m
     for i in range(m - 1, 0, -1):
         out[i] = mont_mul(running, prefix[i - 1], spec)

@@ -447,6 +447,72 @@ def shamir_ladder_mixed(u1_w: jnp.ndarray, u2_w: jnp.ndarray,
     return acc
 
 
+def inv_mont_p_chain(a_mont: jnp.ndarray, spec=None) -> jnp.ndarray:
+    """Fermat inversion mod p via a fixed addition chain — 255
+    squarings (in fori_loop runs) + 13 multiplies, no data-dependent
+    control flow and, unlike the generic `limbs9.inv_mont`, no
+    lax.scan over a captured (256,) exponent-bit constant — which is
+    what makes it usable INSIDE a Pallas kernel (Mosaic cannot
+    materialize captured array constants; kernel window-0 table
+    normalization runs this).
+
+    The chain is specific to P-256's p (the exponent p-2 decomposes
+    into 2^32-1 word runs plus a (2^30-1)·4+1 tail); `spec`, if given,
+    must be the p field.  Verified against `inv_mont` in
+    tests/test_p256_mixed.py.
+    """
+    fp = _consts()[0]
+    if spec is not None and spec.modulus != P:
+        raise ValueError("inv_mont_p_chain is specific to the P-256 p field")
+
+    def sqr_n(x, n):
+        return jax.lax.fori_loop(
+            0, n, lambda _i, v: mont_sqr(v, fp), x)
+
+    a = a_mont
+    x2 = mont_mul(mont_sqr(a, fp), a, fp)            # a^(2^2 - 1)
+    x4 = mont_mul(sqr_n(x2, 2), x2, fp)              # a^(2^4 - 1)
+    x8 = mont_mul(sqr_n(x4, 4), x4, fp)              # a^(2^8 - 1)
+    x16 = mont_mul(sqr_n(x8, 8), x8, fp)             # a^(2^16 - 1)
+    x24 = mont_mul(sqr_n(x16, 8), x8, fp)            # a^(2^24 - 1)
+    x28 = mont_mul(sqr_n(x24, 4), x4, fp)            # a^(2^28 - 1)
+    x30 = mont_mul(sqr_n(x28, 2), x2, fp)            # a^(2^30 - 1)
+    x32 = mont_mul(sqr_n(x30, 2), x2, fp)            # a^(2^32 - 1)
+    # p - 2 as big-endian 32-bit words: FFFFFFFF 00000001 00000000
+    # 00000000 00000000 FFFFFFFF FFFFFFFF FFFFFFFD
+    acc = mont_mul(sqr_n(x32, 32), a, fp)            # FFFFFFFF 00000001
+    acc = sqr_n(acc, 96)                             # three zero words
+    acc = mont_mul(sqr_n(acc, 32), x32, fp)          # FFFFFFFF
+    acc = mont_mul(sqr_n(acc, 32), x32, fp)          # FFFFFFFF
+    acc = mont_mul(sqr_n(acc, 30), x30, fp)          # FFFFFFFD ...
+    acc = mont_mul(sqr_n(acc, 2), a, fp)             # ... = (2^30-1)*4+1
+    return acc
+
+
+def digest_words_to_limbs(dw: jnp.ndarray) -> jnp.ndarray:
+    """(..., 8) uint32 big-endian SHA-256 digest words -> (K, ...) f32
+    limbs of the digest-as-256-bit-integer — the DEVICE-side half of
+    the fused hash->verify path (host twin: `limbs9.be_bytes_to_limbs`
+    over `sha256.digest_to_bytes`; differentially tested equal).
+    Pure shifts/masks + one tiny constant fold, shape-static."""
+    w = jnp.moveaxis(dw.astype(jnp.uint32), -1, 0)   # (8, ...batch)
+    j = np.arange(256)
+    # global bit j (LSB-first) lives in word 7 - j//32, bit j%32
+    rows = w[7 - j // 32]                            # (256, ...batch)
+    shifts = jnp.asarray(j % 32, jnp.uint32).reshape(
+        (256,) + (1,) * (w.ndim - 1))
+    bits = ((rows >> shifts) & jnp.uint32(1)).astype(jnp.float32)
+    pad = jnp.zeros((limbs.RBITS - 256,) + bits.shape[1:], jnp.float32)
+    bits = jnp.concatenate([bits, pad], axis=0)
+    bits = bits.reshape((K, limbs.B) + bits.shape[1:])
+    wts = jnp.asarray((1 << np.arange(limbs.B)).astype(np.float32))
+    # precision-pinned like every limb fold: weights are powers of two
+    # (bf16-exact), but the pin keeps this path out of the "bare
+    # matmul rounds limbs" bug class limbs9.const_dot exists to stop
+    return jnp.tensordot(wts, bits, axes=(0, 1),
+                         precision=limbs.PRECISION)  # (K, ...batch)
+
+
 def _verify_core_impl(e, r, s, qx, qy, rn_lt_p,
                       ladder=shamir_ladder) -> jnp.ndarray:
     """Batched ECDSA-P256 verify on raw limb arrays.
@@ -504,6 +570,37 @@ def _verify_core_impl(e, r, s, qx, qy, rn_lt_p,
 verify_core = jax.jit(_verify_core_impl)
 verify_core_mixed = jax.jit(
     functools.partial(_verify_core_impl, ladder=shamir_ladder_mixed))
+
+
+def _verify_core_fused_impl(words, nblocks, has_msg, e, r, s, qx, qy,
+                            rn_lt_p, ladder=shamir_ladder) -> jnp.ndarray:
+    """The fused hash->verify core: e = SHA-256(m) computed ON DEVICE
+    in the same program as the ECDSA verify — one dispatch, no host
+    digest loop (the host half of the old path hashed per message in
+    msp/identities.digest_for).
+
+    Args (beyond _verify_core_impl's):
+      words: (batch, max_blocks, 16) uint32 — FIPS 180-4 pre-padded
+        message words (bccsp/der.pack_messages).
+      nblocks: (batch,) int32 — real block count per lane; 0 for
+        pre-digested lanes (the compression state freezes at H0 and
+        the lane's digest comes from `e` instead).
+      has_msg: (batch,) bool — which lanes carry a raw message.  Mixed
+        batches are first-class: a bucket can hold raw-message items
+        and pre-digested items and still be ONE device program.
+      e: (K, batch) f32 — host-side digest limbs for the pre-digested
+        lanes (ignored where has_msg).
+    """
+    from fabric_mod_tpu.ops import sha256
+    dw = sha256.sha256_blocks(words, nblocks)        # (batch, 8) u32
+    e_dev = digest_words_to_limbs(dw)                # (K, batch) f32
+    e = jnp.where(has_msg[None], e_dev, e)
+    return _verify_core_impl(e, r, s, qx, qy, rn_lt_p, ladder=ladder)
+
+
+verify_core_fused = jax.jit(_verify_core_fused_impl)
+verify_core_fused_mixed = jax.jit(
+    functools.partial(_verify_core_fused_impl, ladder=shamir_ladder_mixed))
 
 
 # --- Host wrapper ----------------------------------------------------------
@@ -592,32 +689,85 @@ def batch_verify(digests: np.ndarray, r_bytes: np.ndarray,
             arr = jax.device_put(arr, s)
         return arr
 
-    core = verify_core_mixed if _use_mixed() else verify_core
-    if _use_pallas() and mesh is None:
-        # mesh path stays on the XLA core: GSPMD partitions that
-        # program across chips, which it cannot do for the
-        # single-device pallas_call
-        batch = digests.shape[0]
-        tile = next(t for t in (128, 64, 32, 16, 8)
-                    if batch % t == 0) if batch % 8 == 0 else None
-        if tile is not None:
-            core = _pallas_core(tile)
-        # else: an odd direct-caller batch (bccsp buckets are all
-        # multiples of 8) — a lane width under 8 would make the grid
-        # pathological, so stay on the XLA core
+    core = _select_core(digests.shape[0], mesh)
     ok = core(*(_dev(a, s) for a, s in zip(core_args, shardings)))
     if lazy:
         return lambda: np.asarray(ok) & range_ok
     return np.asarray(ok) & range_ok
 
 
+def batch_verify_raw(words: np.ndarray, nblocks: np.ndarray,
+                     has_msg: np.ndarray, digests: np.ndarray,
+                     r_bytes: np.ndarray, s_bytes: np.ndarray,
+                     qx_bytes: np.ndarray, qy_bytes: np.ndarray,
+                     mesh=None, lazy: bool = False):
+    """`batch_verify` with the digest computed ON DEVICE for raw-
+    message lanes: one jitted program runs SHA-256 over the pre-padded
+    message words AND the ECDSA verify (verify_core_fused) — the last
+    host round-trip of the commit path (the per-message hashlib loop)
+    gone.  Lanes with has_msg=False fall back to the `digests` plane,
+    so mixed buckets stay one program.
+
+    `words` is (batch, max_blocks, 16) uint32 from
+    bccsp/der.pack_messages; the other args match `batch_verify`.
+    Honors the same FABRIC_MOD_TPU_MIXED_ADD / FABRIC_MOD_TPU_PALLAS
+    composition, and the same mesh sharding (message words shard on
+    their LEADING batch axis — parallel.fused_verify_shardings).
+    """
+    core_args, range_ok = marshal_inputs(
+        digests, r_bytes, s_bytes, qx_bytes, qy_bytes)
+
+    limb_s = flag_s = words_s = None
+    if mesh is not None:
+        from fabric_mod_tpu.parallel import (fused_verify_shardings,
+                                             verify_shardings)
+        limb_s, flag_s = verify_shardings(mesh)
+        words_s, _ = fused_verify_shardings(mesh)
+
+    def _dev(x, s):
+        arr = jnp.asarray(x)
+        if s is not None:
+            arr = jax.device_put(arr, s)
+        return arr
+
+    core = _select_core(digests.shape[0], mesh, fused=True)
+    ok = core(_dev(np.asarray(words, np.uint32), words_s),
+              _dev(np.asarray(nblocks, np.int32), flag_s),
+              _dev(np.asarray(has_msg, bool), flag_s),
+              *(_dev(a, s) for a, s in zip(
+                  core_args, (limb_s,) * 5 + (flag_s,))))
+    if lazy:
+        return lambda: np.asarray(ok) & range_ok
+    return np.asarray(ok) & range_ok
+
+
+def _select_core(batch: int, mesh, fused: bool = False):
+    """The env-knob composition matrix (PALLAS x MIXED_ADD x fused
+    hash), one place: Pallas when enabled and tileable (single-device
+    only — GSPMD cannot partition a pallas_call, so the mesh path
+    stays on the XLA core), mixed ladder when enabled — the Pallas
+    kernel now IMPLEMENTS the mixed schedule rather than being routed
+    around it (the PR-1 follow-up ROADMAP.md named)."""
+    mixed = _use_mixed()
+    if _use_pallas() and mesh is None and batch % 8 == 0:
+        # odd direct-caller batches (not divisible by 8 — bccsp
+        # buckets always are) stay on the XLA core above: a lane
+        # width under 8 would make the grid pathological
+        tile = next(t for t in (128, 64, 32, 16, 8) if batch % t == 0)
+        return _pallas_core(tile, mixed, fused)
+    if fused:
+        return verify_core_fused_mixed if mixed else verify_core_fused
+    return verify_core_mixed if mixed else verify_core
+
+
 def _use_mixed() -> bool:
     """FABRIC_MOD_TPU_MIXED_ADD=1 swaps the affine-table mixed-
     addition ladder into the verify pipeline (shamir_ladder_mixed) —
     dark-launched pending on-chip measurement, selectable per-run by
-    bench.py --mixed-add.  The Pallas path is routed AROUND it (the
-    kernel still implements the projective schedule): when both are
-    enabled Pallas wins, same as before."""
+    bench.py --mixed-add.  COMPOSES with FABRIC_MOD_TPU_PALLAS: with
+    both set, the VMEM-fused Pallas kernel runs the mixed-addition
+    schedule (ops/p256_pallas.pallas_ladder_mixed) — no longer routed
+    around it."""
     import os
     return os.environ.get("FABRIC_MOD_TPU_MIXED_ADD", "") == "1"
 
@@ -635,6 +785,12 @@ def _use_pallas() -> bool:
 
 
 @functools.lru_cache(maxsize=None)
-def _pallas_core(tile: int):
-    from fabric_mod_tpu.ops.p256_pallas import verify_core_pallas
-    return jax.jit(functools.partial(verify_core_pallas, tile=tile))
+def _pallas_core(tile: int, mixed: bool = False, fused: bool = False):
+    """Jitted Pallas verify core for one (tile, ladder-variant,
+    hash-fusion) combination — lru-cached so each compiles once."""
+    from fabric_mod_tpu.ops import p256_pallas
+    ladder = functools.partial(
+        p256_pallas.pallas_ladder_mixed if mixed
+        else p256_pallas.pallas_ladder, tile=tile)
+    impl = _verify_core_fused_impl if fused else _verify_core_impl
+    return jax.jit(functools.partial(impl, ladder=ladder))

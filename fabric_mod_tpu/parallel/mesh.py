@@ -50,6 +50,21 @@ def verify_shardings(mesh):
     return NamedSharding(mesh, P(None, "dp")), NamedSharding(mesh, P("dp"))
 
 
+def fused_verify_shardings(mesh):
+    """(words_sharding, flag_sharding) for the fused hash->verify
+    message operands (ops/p256.batch_verify_raw).
+
+    Message words are (batch, max_blocks, 16) uint32 — unlike the
+    limb arrays, the batch is the LEADING axis (ops/sha256.py layout:
+    lax.scan walks the block axis, the compression state is
+    (batch, 8)), so the dp split goes on axis 0 and the block/word
+    axes stay whole.  nblocks/has_msg are (batch,) flags."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return (NamedSharding(mesh, P("dp", None, None)),
+            NamedSharding(mesh, P("dp")))
+
+
 def replicated(mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -48,6 +48,11 @@ _DEDUP_SAVED_OPTS = MetricOpts(
     help="Verify requests answered by within-block dedup instead of a "
          "device lane (meta-policies and key-level candidates re-stage "
          "identical signature sets).")
+_RAW_ITEMS_OPTS = MetricOpts(
+    "fabric", "validator", "staged_raw_message_items",
+    help="Staged items carrying raw messages instead of host digests "
+         "(FABRIC_MOD_TPU_FUSED_HASH: e = H(m) computed on device in "
+         "the same program as the verify).")
 
 
 @functools.lru_cache(maxsize=None)
@@ -55,7 +60,8 @@ def _stage_metrics():
     prov = default_provider()
     return (prov.histogram(_STAGED_ITEMS_OPTS,
                            buckets=(1, 8, 64, 256, 512, 1024, 2048)),
-            prov.counter(_DEDUP_SAVED_OPTS))
+            prov.counter(_DEDUP_SAVED_OPTS),
+            prov.counter(_RAW_ITEMS_OPTS))
 
 
 class ValidationInfoProvider:
@@ -381,9 +387,15 @@ class TxValidator:
         # (bccsp/tpu.VerdictCache); within-block repeats never reach
         # it thanks to the collector's dedup, and both effects are
         # exported so coalescing stays observable.
-        staged_hist, dedup_ctr = _stage_metrics()
+        staged_hist, dedup_ctr, raw_ctr = _stage_metrics()
         staged_hist.observe(len(collector.items))
         dedup_ctr.add(collector.requests - len(collector.items))
+        # Raw-message items (identities emit them under FABRIC_MOD_
+        # TPU_FUSED_HASH) flow through the same collector/dedup into
+        # p256.batch_verify_raw — counted so the fused rollout is
+        # observable per block.
+        raw_ctr.add(sum(1 for it in collector.items
+                        if getattr(it, "message", None) is not None))
         async_fn = getattr(self._verifier, "verify_many_async", None)
         if async_fn is not None:
             mask_fn = async_fn(collector.items)

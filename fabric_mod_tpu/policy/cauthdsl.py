@@ -53,7 +53,12 @@ class BatchCollector:
 
     def add(self, item: VerifyItem) -> int:
         self.requests += 1
-        key = (item.digest, item.signature, item.public_xy)
+        # message MUST be part of the key: two raw-message items
+        # (FABRIC_MOD_TPU_FUSED_HASH) share digest=b"" — deduping on
+        # (digest, sig, key) alone would let a replayed signature over
+        # a DIFFERENT message share the valid item's verdict slot
+        key = (item.digest, item.signature, item.public_xy,
+               getattr(item, "message", None))
         got = self._index.get(key)
         if got is not None:
             return got

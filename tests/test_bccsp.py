@@ -30,6 +30,8 @@ def test_high_s_rejected(swcsp):
     assert not swcsp.verify(key.public_key(), high, digest)
 
 
+@pytest.mark.skipif(not sw.HAVE_CRYPTOGRAPHY,
+                    reason="P-384 is outside the pure-python fallback")
 def test_p384_roundtrip(swcsp):
     key = swcsp.key_gen("P384")
     digest = swcsp.hash(b"msg", "SHA384")
@@ -47,6 +49,8 @@ def test_keystore_roundtrip(tmp_path):
     assert fresh.verify(loaded.public_key(), fresh.sign(loaded, digest), digest)
 
 
+@pytest.mark.skipif(not sw.HAVE_CRYPTOGRAPHY,
+                    reason="AES is outside the pure-python fallback")
 def test_aes_roundtrip(swcsp):
     key = swcsp.key_gen("AES256")
     ct = swcsp.encrypt(key, b"secret payload")

@@ -98,7 +98,9 @@ def test_revoked_intermediate_poisons_leaf(org):
 
 
 def test_crl_revocation(org):
-    from cryptography import x509
+    # CRL building/parsing is outside the wheel-less x509 fallback's
+    # scope (bccsp/_x509fallback.py) — real wheel only
+    x509 = pytest.importorskip("cryptography.x509")
     from cryptography.hazmat.primitives import hashes
     now = datetime.datetime.now(datetime.timezone.utc)
     cert, key = org["root"].issue("crled@org1", "Org1")
@@ -127,9 +129,13 @@ def test_crl_revocation(org):
 def test_key_usage_enforced(org):
     """A leaf whose KeyUsage forbids digitalSignature can't sign —
     reject it at validation time."""
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes
-    from cryptography.hazmat.primitives.asymmetric import ec as _ec
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import ec as _ec
+    except ImportError:       # wheel-less: the x509 fallback issues too
+        from fabric_mod_tpu.bccsp import _x509fallback as x509
+        from fabric_mod_tpu.bccsp._ecfallback import ec as _ec, hashes
     key = _ec.generate_private_key(_ec.SECP256R1())
     now = datetime.datetime.now(datetime.timezone.utc)
     cert = (x509.CertificateBuilder()
@@ -225,3 +231,30 @@ def test_cached_msp_agrees(org):
     for _ in range(2):
         with pytest.raises(MSPValidationError):
             cached.validate(bad)
+
+
+def test_verify_item_fused_hash_emits_raw_message(org, monkeypatch):
+    """Under FABRIC_MOD_TPU_FUSED_HASH the identity stages the RAW
+    message (digest computed on device by the TPU provider); default
+    stays the host-digest item.  Both shapes verify identically
+    through a host provider (the device twin runs in bench
+    --metric hashverify)."""
+    from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
+
+    ident = _ident(org, "peer")
+    msg = b"fused staging probe"
+    sig = ident.sign_message(msg)
+
+    monkeypatch.delenv("FABRIC_MOD_TPU_FUSED_HASH", raising=False)
+    plain = ident.verify_item(msg, sig)
+    assert plain.message is None and len(plain.digest) == 32
+
+    monkeypatch.setenv("FABRIC_MOD_TPU_FUSED_HASH", "1")
+    raw = ident.verify_item(msg, sig)
+    assert raw.message == msg and raw.digest == b""
+    assert raw.public_xy == plain.public_xy
+
+    v = FakeBatchVerifier(org["csp"])
+    assert list(v.verify_many([plain, raw])) == [True, True]
+    bad = ident.verify_item(msg + b"!", sig)
+    assert list(v.verify_many([bad])) == [False]

@@ -90,6 +90,30 @@ def test_inv_mont_many_matches_single_inversions(rng):
                 assert g == pow(v, -1, P), (i, lane)
 
 
+def test_inv_mont_p_chain_matches_generic(rng):
+    """The scan-free Fermat addition chain (the in-kernel inversion of
+    the Pallas mixed ladder) computes the same inverses as the generic
+    square-and-multiply, including the zero-poisons-its-lane
+    property the simultaneous inversion relies on."""
+    import jax.numpy as jnp
+    fp = FieldSpec.make("p256.p", P)
+    vals = [rng.randrange(1, P) for _ in range(4)] + [0]
+    a = limbs.to_device(np.stack(
+        [limbs.int_to_limbs(v * R % P) for v in vals]))
+    got = p256.inv_mont_p_chain(a, fp)
+    want = limbs.inv_mont(a, fp)
+    assert np.array_equal(
+        np.asarray(limbs.canonical(got, fp)),
+        np.asarray(limbs.canonical(want, fp)))
+    rinv = pow(R, -1, P)
+    for i, v in enumerate(vals):
+        g = limbs.limbs_to_int(
+            np.asarray(limbs.canonical(got[:, i], fp))) * rinv % P
+        assert g == (pow(v, -1, P) if v else 0), i
+    with pytest.raises(ValueError):
+        p256.inv_mont_p_chain(a, FieldSpec.make("p256.n", N))
+
+
 def test_mixed_ladder_matches_projective(rng):
     """Affine results of the two ladders agree on random windows plus
     the zero-window edge lanes (all-zero -> infinity; u2-only zero)."""

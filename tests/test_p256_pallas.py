@@ -42,8 +42,13 @@ def _canon_xyz(xyz):
     return [np.asarray(L.canonical(c, fp)) for c in xyz]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("batch,tile", [(8, 8), (16, 8)])
 def test_pallas_ladder_matches_xla(rng, batch, tile):
+    """Interpret-mode bare-ladder differential — slow (5+ min of
+    Pallas interpreter per param on CPU); tier-1's fast smoke is
+    test_pallas_verify_core_agrees_on_real_signatures, which drives
+    the same kernel end-to-end through the verify core."""
     u1, u2, qx, qy = _random_inputs(rng, batch)
     want = _canon_xyz(p256.shamir_ladder(u1, u2, qx, qy))
     got = _canon_xyz(pp.pallas_ladder(u1, u2, qx, qy, tile=tile,
@@ -72,3 +77,77 @@ def test_pallas_verify_core_agrees_on_real_signatures(rng, sigbatch8):
     assert (want == got).all()
     assert want.tolist() == [True, True, True, False,
                              True, True, True, True]
+
+
+@pytest.mark.slow
+def test_mixed_ladder_matches_projective(rng):
+    """The Pallas MIXED ladder vs both XLA ladders, random windows
+    plus identity-adjacent edge vectors: all-zero lanes (the
+    accumulator stays at infinity through every keep-select), zero-Q
+    and zero-G window streaks (affine tables have no infinity row —
+    the keep-select must cover every one), and single-window values.
+
+    Canonical equality against the XLA MIXED ladder (identical
+    formulas, identical order); affine-point equality against the
+    PROJECTIVE ladder (representatives differ by a Z scale)."""
+    import jax.numpy as jnp
+    batch, tile = 8, 8
+    u1, u2, qx, qy = _random_inputs(rng, batch)
+    u1 = np.asarray(u1).copy()
+    u2 = np.asarray(u2).copy()
+    u1[:, 0] = 0                           # lane 0: u1*G vanishes ...
+    u2[:, 0] = 0                           # ... and u2*Q: stays at inf
+    u2[:, 1] = 0                           # lane 1: G-adds only
+    u1[:, 2] = 0                           # lane 2: Q-adds only
+    u1[1:, 3] = 0                          # lane 3: one MSB window
+    u2[:p256.N_WINDOWS - 1, 4] = 0         # lane 4: one LSB window
+    u1, u2 = jnp.asarray(u1), jnp.asarray(u2)
+
+    got = _canon_xyz(pp.pallas_ladder_mixed(u1, u2, qx, qy, tile=tile,
+                                            interpret=True))
+    want_mixed = _canon_xyz(p256.shamir_ladder_mixed(u1, u2, qx, qy))
+    for w, g, name in zip(want_mixed, got, "XYZ"):
+        assert (w == g).all(), f"{name} mismatch vs XLA mixed"
+
+    # vs the projective ladder: compare affine results per lane
+    fp = L.FieldSpec.make("p256.p", p256.P)
+    want_proj = _canon_xyz(p256.shamir_ladder(u1, u2, qx, qy))
+
+    def to_affine(xyz, lane):
+        X, Y, Z = (L.limbs_to_int(c[:, lane]) for c in xyz)
+        rinv = pow(1 << L.RBITS, -1, p256.P)
+        X, Y, Z = (v * rinv % p256.P for v in (X, Y, Z))
+        if Z == 0:
+            return None
+        zi = pow(Z, -1, p256.P)
+        return (X * zi % p256.P, Y * zi % p256.P)
+
+    for lane in range(batch):
+        assert to_affine(got, lane) == to_affine(want_proj, lane), lane
+    assert to_affine(got, 0) is None       # all-zero lane -> infinity
+
+
+@pytest.mark.slow
+def test_pallas_mixed_verify_core_verdicts(rng, sigbatch8):
+    """Verdict-level differential incl. adversarial lanes (tampered
+    digest, zero s, overrange r >= n — the range-check wrap the
+    rn_lt_p plumbing guards — off-curve key, high-s mirror): the
+    Pallas mixed core must agree with the projective XLA core
+    verdict-for-verdict."""
+    d, r, s, qx, qy = sigbatch8
+    d, r, s, qy = d.copy(), r.copy(), s.copy(), qy.copy()
+    d[3][5] ^= 1                           # tampered digest
+    s[1][:] = 0                            # zero s
+    r[2][:] = np.frombuffer(p256.N.to_bytes(32, "big"), np.uint8)
+    qy[4][31] ^= 1                         # off-curve key
+    s_int = int.from_bytes(bytes(s[5]), "big")
+    s[5] = np.frombuffer((p256.N - s_int).to_bytes(32, "big"), np.uint8)
+    core_args, range_ok = p256.marshal_inputs(d, r, s, qx, qy)
+    want = np.asarray(p256.verify_core(*core_args)) & range_ok
+    got = np.asarray(pp.verify_core_pallas(
+        *core_args, tile=8, interpret=True, mixed=True)) & range_ok
+    assert (want == got).all()
+    # lane 5 stays True: the device core accepts the (r, n-s) mirror —
+    # the low-S REJECTION is marshal_items' host-side rule, not math
+    assert want.tolist() == [True, False, False, False,
+                             False, True, True, True]

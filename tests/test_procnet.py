@@ -232,7 +232,10 @@ class ProcNet:
 
     # -- client ------------------------------------------------------------
     def _identity(self, org, kind, name):
-        from cryptography import x509
+        try:
+            from cryptography import x509
+        except ImportError:   # wheel-less: bccsp/_x509fallback.py
+            from fabric_mod_tpu.bccsp import _x509fallback as x509
         from fabric_mod_tpu.bccsp.sw import SwCSP
         from fabric_mod_tpu.msp.identities import SigningIdentity
         base = os.path.join(self.crypto_dir, org)

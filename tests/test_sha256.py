@@ -24,3 +24,24 @@ def test_random_batch(rng):
 
 def test_empty_batch():
     assert sha256.sha256_many([]).shape == (0, 32)
+
+
+def test_digest_words_to_limbs_matches_host_path():
+    """The fused hash->verify's device-side digest-to-limb conversion
+    equals the host path (digest bytes -> be_bytes_to_limbs) bit for
+    bit — the seam that lets e = H(m) stay on device."""
+    import jax.numpy as jnp
+
+    from fabric_mod_tpu.ops import limbs9, p256
+
+    msgs = [b"fused-%d" % i * (i + 1) for i in range(7)]
+    words, nb = sha256.pad_messages(msgs)
+    dw = np.asarray(sha256.sha256_blocks(jnp.asarray(words),
+                                         jnp.asarray(nb)))
+    host_digests = np.stack([
+        np.frombuffer(hashlib.sha256(m).digest(), np.uint8)
+        for m in msgs])
+    want = np.moveaxis(limbs9.be_bytes_to_limbs(host_digests),
+                       -1, 0).astype(np.float32)
+    got = np.asarray(p256.digest_words_to_limbs(jnp.asarray(dw)))
+    assert np.array_equal(got, want)

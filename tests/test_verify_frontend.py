@@ -136,7 +136,8 @@ def test_marshal_items_matches_per_item_semantics():
     # a coalesced service batch
     items.append(VerifyItem(digest, None, key))
     items.append(VerifyItem(None, _der_sig(5, 9), key))
-    d, r, s, qx, qy, pre_ok = marshal_items(items, 9)
+    d, r, s, qx, qy, pre_ok, msg = marshal_items(items, 9)
+    assert msg is None                     # no raw-message items here
     assert list(pre_ok) == [True, False, False, False, False, True,
                             False, False, False]
     assert int.from_bytes(r[0].tobytes(), "big") == 5
@@ -145,6 +146,113 @@ def test_marshal_items_matches_per_item_semantics():
     assert bytes(qx[0]) == key[:32] and bytes(qy[0]) == key[32:]
     # masked rows are fully zeroed
     assert not r[3].any() and not s[3].any()
+
+
+# --- the fused-hash message lane -------------------------------------------
+
+def test_pack_messages_matches_per_item_padding():
+    """The vectorized FIPS 180-4 padder is byte-identical to the
+    per-item loop it vectorizes (ops/sha256.pad_messages), including
+    the empty message and multi-block lengths."""
+    from fabric_mod_tpu.ops import sha256 as sh
+    msgs = [b"", b"a", b"x" * 55, b"y" * 56, b"z" * 64, b"w" * 200]
+    want_w, want_nb = sh.pad_messages(msgs)
+    got_w, got_nb, ok = der.pack_messages(msgs)
+    assert ok.all()
+    assert np.array_equal(want_nb, got_nb)
+    assert np.array_equal(want_w, got_w)
+    # pow2 rounding pads blocks, zero-fills, and never changes real rows
+    w8, nb8, ok8 = der.pack_messages(msgs, rows=8, round_blocks_pow2=True)
+    assert w8.shape[1] == 4 and np.array_equal(w8[:6, :want_w.shape[1]],
+                                               want_w)
+    assert not w8[6:].any() and not ok8[6:].any()
+    # non-bytes rows mask, never raise (coalesced-batch contract)
+    wb, nbb, okb = der.pack_messages([b"fine", None, 7], rows=3)
+    assert list(okb) == [True, False, False]
+
+
+def test_marshal_items_message_lane():
+    """Raw-message items ride the message lane: digest plane unused,
+    nblocks zeroed for pre-digested lanes, non-bytes messages mask
+    their row without poisoning the batch."""
+    key = b"\x07" * 64
+    sig = _der_sig(5, 9)
+    digest = bytes(range(32))
+    items = [
+        VerifyItem(b"", sig, key, message=b"m" * 100),   # raw
+        VerifyItem(digest, sig, key),                    # pre-digested
+        VerifyItem(b"", sig, key, message=None),         # empty digest
+        VerifyItem(b"", sig, key, message=123),          # bad message
+    ]
+    d, r, s, qx, qy, pre_ok, msg = marshal_items(items, 6)
+    assert msg is not None
+    words, nblocks, has_msg = msg
+    assert list(has_msg) == [True, False, False, True, False, False]
+    assert list(pre_ok) == [True, True, False, False, False, False]
+    assert nblocks[0] == 2 and nblocks[1] == 0   # 100B msg = 2 blocks
+    assert bytes(d[1]) == digest
+
+
+def test_raw_and_predigested_items_verdict_identical():
+    """The fused-path CONTRACT at the provider seam: a raw-message
+    item and its hash-equivalent pre-digested twin produce identical
+    verdicts.  Host provider here (device-free tier-1); the device
+    twin of this assertion runs in bench --metric hashverify /
+    diffverify and tests/test_p256_pallas.py."""
+    import hashlib
+
+    from fabric_mod_tpu.bccsp.sw import SwCSP
+    from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
+
+    csp = SwCSP()
+    k = csp.key_gen()
+    msgs = [b"alpha" * 9, b"beta", b"gamma" * 40]
+    sigs = [csp.sign(k, hashlib.sha256(m).digest()) for m in msgs]
+    msgs[1] += b"!"                        # tampered message lane
+    raw = [VerifyItem(b"", sg, k.public_xy(), message=m)
+           for m, sg in zip(msgs, sigs)]
+    dig = [VerifyItem(hashlib.sha256(m).digest(), sg, k.public_xy())
+           for m, sg in zip(msgs, sigs)]
+    v = FakeBatchVerifier(csp)
+    got_raw = list(v.verify_many(raw))
+    got_dig = list(v.verify_many(dig))
+    assert got_raw == got_dig == [True, False, True]
+
+
+def test_batch_collector_keys_raw_items_on_message():
+    """Two raw-message items sharing (digest=b'', sig, key) but with
+    DIFFERENT messages must occupy different collector slots — a
+    dedup collision here would let a replayed signature over another
+    message inherit the valid item's verdict (staging-layer twin of
+    the VerdictCache key rule)."""
+    from fabric_mod_tpu.policy.cauthdsl import BatchCollector
+
+    c = BatchCollector()
+    a = c.add(VerifyItem(b"", b"sig", b"k" * 64, message=b"msgA"))
+    b = c.add(VerifyItem(b"", b"sig", b"k" * 64, message=b"msgB"))
+    assert a != b and len(c.items) == 2
+    # identical raw items still dedup
+    assert c.add(VerifyItem(b"", b"sig", b"k" * 64, message=b"msgA")) == a
+    # pre-digested items keep deduping as before
+    d1 = c.add(VerifyItem(b"\x01" * 32, b"sig", b"k" * 64))
+    assert c.add(VerifyItem(b"\x01" * 32, b"sig", b"k" * 64)) == d1
+
+
+def test_verdict_cache_keys_raw_items_on_message():
+    """Two raw items differing ONLY in message must not collide in the
+    memo-cache; a raw item and a pre-digested item never share a key."""
+    k1 = VerdictCache.key_of(VerifyItem(b"", b"sig", b"k" * 64,
+                                        message=b"m1"))
+    k2 = VerdictCache.key_of(VerifyItem(b"", b"sig", b"k" * 64,
+                                        message=b"m2"))
+    k3 = VerdictCache.key_of(VerifyItem(b"", b"sig", b"k" * 64))
+    assert k1 != k2 and k1 != k3 and k2 != k3
+    # bytearray messages coerce; weirder types are uncacheable
+    kb = VerdictCache.key_of(VerifyItem(b"", b"sig", b"k" * 64,
+                                        message=bytearray(b"m1")))
+    assert kb == k1
+    assert VerdictCache.key_of(
+        VerifyItem(b"", b"sig", b"k" * 64, message=1.5)) is None
 
 
 # --- verdict memo-cache -----------------------------------------------------
