@@ -383,30 +383,18 @@ def measure_device(items, expect, reps: int) -> float:
 def _block_world(n_txs: int):
     """A 1000-tx-style block world: 3 orgs, 2-of-3 endorsement
     (BASELINE config #2; reference: txvalidator/v20/validator.go:182)."""
-    from fabric_mod_tpu.bccsp.sw import SwCSP
     from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder
     from fabric_mod_tpu.msp import ca as calib
     from fabric_mod_tpu.msp.identities import SigningIdentity
-    from fabric_mod_tpu.msp.mspimpl import Msp, MspManager
     from fabric_mod_tpu.peer import TxValidator, ValidationInfoProvider
-    from fabric_mod_tpu.policy import ApplicationPolicyEvaluator, from_string
-    from fabric_mod_tpu.protos import messages as m
+    from fabric_mod_tpu.policy import ApplicationPolicyEvaluator
     from fabric_mod_tpu.protos import protoutil
 
-    csp = SwCSP()
-    msps, signers = [], {}
-    for org in ("Org1", "Org2", "Org3"):
-        ca = calib.CA(f"ca.{org.lower()}", org)
-        msps.append(Msp(org, csp, [ca.cert]))
-        cert, key = ca.issue(f"peer0.{org.lower()}", org, ous=["peer"])
-        signers[org] = SigningIdentity(org, cert, calib.key_pem(key), csp)
-        if org == "Org1":
-            ccert, ckey = ca.issue("client@org1", org, ous=["client"])
-            signers["client"] = SigningIdentity(
-                org, ccert, calib.key_pem(ckey), csp)
-    mgr = MspManager(msps)
-    policy = m.ApplicationPolicy(signature_policy=from_string(
-        "OutOf(2, 'Org1.peer', 'Org2.peer', 'Org3.peer')")).encode()
+    csp, cas, mgr, signers, policy = _three_org_world()
+    ccert, ckey = cas["Org1"].issue("client@org1", "Org1",
+                                    ous=["client"])
+    signers["client"] = SigningIdentity(
+        "Org1", ccert, calib.key_pem(ckey), csp)
 
     envs = []
     for i in range(n_txs):
@@ -422,6 +410,225 @@ def _block_world(n_txs: int):
                            ApplicationPolicyEvaluator(mgr), verifier,
                            ValidationInfoProvider(policy))
     return block, make_validator
+
+
+def _three_org_world():
+    """The shared bench world: 3 orgs, one peer signer each, the
+    2-of-3 endorsement policy (BASELINE config #2).  Returns
+    (csp, cas, mgr, signers, policy_bytes); _block_world and
+    _commitpipe_world both build on it."""
+    from fabric_mod_tpu.bccsp.sw import SwCSP
+    from fabric_mod_tpu.msp import ca as calib
+    from fabric_mod_tpu.msp.identities import SigningIdentity
+    from fabric_mod_tpu.msp.mspimpl import Msp, MspManager
+    from fabric_mod_tpu.policy import from_string
+    from fabric_mod_tpu.protos import messages as m
+
+    csp = SwCSP()
+    cas, msps, signers = {}, [], {}
+    for org in ("Org1", "Org2", "Org3"):
+        ca = calib.CA(f"ca.{org.lower()}", org)
+        cas[org] = ca
+        msps.append(Msp(org, csp, [ca.cert]))
+        cert, key = ca.issue(f"peer0.{org.lower()}", org, ous=["peer"])
+        signers[org] = SigningIdentity(org, cert, calib.key_pem(key), csp)
+    policy = m.ApplicationPolicy(signature_policy=from_string(
+        "OutOf(2, 'Org1.peer', 'Org2.peer', 'Org3.peer')")).encode()
+    return csp, cas, MspManager(msps), signers, policy
+
+
+def _commitpipe_world(n_blocks: int, txs_per_block: int):
+    """An in-order block stream with MIXED barrier and non-barrier
+    blocks: every 6th block carries a VALIDATION_PARAMETER metadata
+    write pinning key "pinned" to an alternating single org (a
+    `needs_barrier` block), and the NEXT block writes "pinned" under
+    endorsements that only sometimes satisfy the pin — so the final
+    txflags genuinely depend on barrier-correct ordering, and the
+    pipelined/sync differential can't pass by accident.
+
+    Returns (encoded_blocks, make_committer, barrier_count) where
+    make_committer builds a fresh (ledger, validator) pair wired for
+    key-level policies (state_metadata) against a fresh directory."""
+    from fabric_mod_tpu.ledger import KvLedger
+    from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder
+    from fabric_mod_tpu.peer import TxValidator, ValidationInfoProvider
+    from fabric_mod_tpu.peer.txvalidator import VALIDATION_PARAMETER
+    from fabric_mod_tpu.policy import ApplicationPolicyEvaluator, from_string
+    from fabric_mod_tpu.protos import messages as m
+    from fabric_mod_tpu.protos import protoutil
+
+    _csp, _cas, mgr, signers, cc_policy = _three_org_world()
+
+    def org_policy(org):
+        return m.ApplicationPolicy(
+            signature_policy=from_string(f"'{org}.peer'")).encode()
+
+    def tx(rwset_bytes, endorsers):
+        return protoutil.create_signed_tx(
+            "bench", "mycc", rwset_bytes, signers["Org1"],
+            [signers[o] for o in endorsers])
+
+    log(f"commitpipe: signing {n_blocks} blocks x {txs_per_block} txs ...")
+    blocks, prev, barriers = [], b"", 0
+    for n in range(n_blocks):
+        envs = []
+        for j in range(txs_per_block):
+            b = RWSetBuilder()
+            if n == 0 and j == 0:
+                # seed "pinned" so the first VP pin has a key to bind
+                # to (statedb drops metadata writes on absent keys —
+                # an unseeded pin would be a silent no-op and the
+                # first barrier would carry no verdict signal)
+                b.add_write("mycc", "pinned", b"v0")
+                envs.append(tx(b.build().encode(), ("Org1", "Org2")))
+                continue
+            if j == 0 and n % 6 == 5:
+                # barrier block: re-pin "pinned" to the next org in
+                # the alternation.  Metadata-only write (any other
+                # key would drag in the cc-wide policy), endorsed by
+                # whichever org the STANDING pin requires — changing
+                # a pinned key's VP must itself satisfy the current
+                # pin, so a 2-of-3 re-pin after the first would fail
+                # forever and the alternating signal would be dead
+                k = barriers
+                pin_orgs = ("Org3", "Org1")
+                b.add_metadata_write("mycc", "pinned",
+                                     VALIDATION_PARAMETER,
+                                     org_policy(pin_orgs[k % 2]))
+                endorsers = (("Org1", "Org2") if k == 0
+                             else (pin_orgs[(k - 1) % 2],))
+                envs.append(tx(b.build().encode(), endorsers))
+                barriers += 1
+                continue
+            if j == 1 and n % 6 == 0 and n > 0:
+                # first block AFTER a barrier: write the pinned key.
+                # Org1+Org2 endorsements satisfy the Org1 pin but not
+                # the Org3 pin (the pins alternate), so the verdict
+                # depends on the PREVIOUS block's committed VP — a
+                # stage-ahead bug reads the stale pin (or none) and
+                # flips this tx's flag
+                b.add_write("mycc", "pinned", b"v%d" % n)
+                envs.append(tx(b.build().encode(), ("Org1", "Org2")))
+                continue
+            b.add_write("mycc", f"blk{n}tx{j}", b"v")
+            envs.append(tx(b.build().encode(), ("Org1", "Org2")))
+        blk = protoutil.new_block(n, prev, envs)
+        prev = protoutil.block_header_hash(blk.header)
+        blocks.append(blk.encode())
+
+    def make_committer(verifier, root):
+        led = KvLedger(root, "bench")
+
+        def state_vp(ns, key):
+            meta = led.state.get_metadata(ns, key)
+            return meta.get(VALIDATION_PARAMETER) if meta else None
+        validator = TxValidator(
+            "bench", mgr, ApplicationPolicyEvaluator(mgr), verifier,
+            ValidationInfoProvider(cc_policy),
+            tx_id_exists=led.tx_id_exists, state_metadata=state_vp)
+        return led, validator
+    return blocks, make_committer, barriers
+
+
+def measure_commitpipe(n_blocks: int, txs_per_block: int, depth: int,
+                       use_sw: bool) -> dict:
+    """Whole-pipeline committed-tx/s A/B: the synchronous Committer vs
+    the PipelinedCommitter over the SAME block stream into fresh
+    ledgers.  Per-block txflags and the final ledger state fingerprint
+    are asserted bit-identical (and depth=1 is additionally asserted
+    identical to sync) BEFORE any rate is reported — the number can't
+    come from a wrong-answer shortcut."""
+    import tempfile
+
+    from fabric_mod_tpu.peer import (Committer, PipelinedCommitter,
+                                     ValidatorCommitTarget)
+    from fabric_mod_tpu.protos import messages as m
+
+    if use_sw:
+        from fabric_mod_tpu.bccsp.sw import SwCSP
+        from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
+        verifier = FakeBatchVerifier(SwCSP())
+    else:
+        from fabric_mod_tpu.bccsp.tpu import TpuVerifier
+        # memo-cache off: every block's items are distinct anyway, and
+        # the A/B must measure the pipeline, not the LRU
+        verifier = TpuVerifier(cache_size=0)
+    blocks, make_committer, barriers = _commitpipe_world(
+        n_blocks, txs_per_block)
+    n_txs = n_blocks * txs_per_block
+
+    def run_sync(root):
+        led, validator = make_committer(verifier, root)
+        committer = Committer(validator, led)
+        flags = []
+        t0 = time.perf_counter()
+        for raw in blocks:
+            flags.append(list(committer.store_block(m.Block.decode(raw))))
+        dt = time.perf_counter() - t0
+        return flags, led.state_fingerprint(), n_txs / dt
+
+    def run_pipe(root, d):
+        led, validator = make_committer(verifier, root)
+        flags = []
+        pipe = PipelinedCommitter(
+            ValidatorCommitTarget(validator, led), depth=d,
+            on_commit=lambda _b, f: flags.append(list(f)))
+        t0 = time.perf_counter()
+        for raw in blocks:
+            pipe.submit(m.Block.decode(raw))
+        pipe.flush()
+        dt = time.perf_counter() - t0
+        pipe.close()
+        return flags, led.state_fingerprint(), n_txs / dt
+
+    with tempfile.TemporaryDirectory(prefix="fmt_commitpipe_") as tmp:
+        if not use_sw:
+            # warm-up: compile the verify bucket outside the timing
+            led, validator = make_committer(verifier, tmp + "/warm")
+            t0 = time.perf_counter()
+            Committer(validator, led).store_block(m.Block.decode(blocks[0]))
+            log(f"commitpipe warm-up (incl. compile): "
+                f"{time.perf_counter() - t0:.1f}s")
+        sync_flags, sync_fp, sync_rate = run_sync(tmp + "/sync")
+        log(f"sync committer: {sync_rate:,.0f} committed tx/s")
+        pipe_flags, pipe_fp, pipe_rate = run_pipe(tmp + "/pipe", depth)
+        log(f"pipelined (depth={depth}): {pipe_rate:,.0f} committed tx/s "
+            f"({pipe_rate / sync_rate:.2f}x)")
+        d1_flags, d1_fp, _ = run_pipe(tmp + "/depth1", 1)
+
+    flags_ok = pipe_flags == sync_flags
+    state_ok = pipe_fp == sync_fp
+    depth1_ok = d1_flags == sync_flags and d1_fp == sync_fp
+    if not flags_ok:
+        bad = [i for i, (a, b) in enumerate(zip(pipe_flags, sync_flags))
+               if a != b]
+        raise AssertionError(
+            f"pipelined txflags diverge from sync at blocks {bad[:5]}")
+    if not state_ok:
+        raise AssertionError("pipelined state fingerprint diverges")
+    if not depth1_ok:
+        raise AssertionError("depth=1 does not match the sync path")
+    # the interesting flags actually flipped (the stream exercised the
+    # barrier-dependent verdicts, not just all-VALID blocks) — an
+    # all-VALID stream would let the differential pass vacuously
+    distinct = {f for per_block in sync_flags for f in per_block}
+    if distinct == {0}:
+        raise AssertionError(
+            "commitpipe stream produced only VALID flags — the "
+            "barrier-dependent verdicts the oracle relies on are gone")
+    return {
+        "pipelined_tx_per_sec": round(pipe_rate, 1),
+        "sync_tx_per_sec": round(sync_rate, 1),
+        "blocks": n_blocks,
+        "txs_per_block": txs_per_block,
+        "barrier_blocks": barriers,
+        "depth": depth,
+        "distinct_flags": sorted(distinct),
+        "flags_identical": flags_ok,
+        "state_hash_identical": state_ok,
+        "depth1_identical": depth1_ok,
+        "verifier": "sw" if use_sw else "device",
+    }
 
 
 def measure_block(n_txs: int, reps: int) -> tuple:
@@ -687,6 +894,29 @@ def run_worker(args) -> int:
         out["platform"] = jax.devices()[0].platform
         print(json.dumps(out))
         return 0
+    if args.metric == "commitpipe":
+        # blocks scale with --batch at 8 txs/block, floor 32 blocks
+        # (the acceptance stream); barrier cadence is fixed inside
+        n_blocks = max(32, args.batch // 8)
+        extras = measure_commitpipe(
+            n_blocks, 8, max(1, args.pipeline_depth),
+            use_sw=args.commitpipe_verifier == "sw")
+        pipe_rate = extras.pop("pipelined_tx_per_sec")
+        out = {
+            "metric": "commitpipe_committed_tx_per_sec",
+            "value": pipe_rate,
+            "unit": "tx/s",
+            "vs_baseline": round(pipe_rate / extras["sync_tx_per_sec"], 3),
+            **extras,
+        }
+        if args.commitpipe_verifier == "sw":
+            # host-only A/B: no device banner needed
+            print(json.dumps(out))
+            return 0
+        import jax
+        out["platform"] = jax.devices()[0].platform
+        print(json.dumps(out))
+        return 0
     if args.metric == "block":
         dev_rate, sw_rate = measure_block(min(args.batch, 1000), args.reps)
         out = {
@@ -882,6 +1112,11 @@ def supervise(args, argv) -> int:
         # vs_baseline ratio stays honest, the wall-clock stays small
         cpu_argv = ["--batch", str(min(args.batch, 512)), "--reps", "1",
                     "--metric", args.metric]
+        if args.metric == "commitpipe":
+            # keep the pipeline shape; drop to the sw backend so the
+            # fallback doesn't pay a multi-minute CPU XLA compile
+            cpu_argv += ["--pipeline-depth", str(args.pipeline_depth),
+                         "--commitpipe-verifier", "sw"]
     result, note = _spawn_worker(cpu_argv, cpu_env, timeout_s)
     log(f"[bench] cpu fallback: {note}")
     if result is not None:
@@ -907,7 +1142,8 @@ def main() -> int:
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--metric", action="append",
                     choices=("verify", "block", "e2e", "idemix", "gossip",
-                             "marshal", "diffverify", "hashverify"),
+                             "marshal", "diffverify", "hashverify",
+                             "commitpipe"),
                     default=None,
                     help="repeatable: each metric runs in sequence and "
                          "prints its own JSON line (the smoke target "
@@ -927,6 +1163,13 @@ def main() -> int:
     ap.add_argument("--precision", choices=("highest", "high"),
                     default=None,
                     help="limb matmul precision — bench-scoped A/B only")
+    ap.add_argument("--pipeline-depth", type=int, default=4,
+                    help="commitpipe: staged-but-uncommitted block "
+                         "bound (1 = the synchronous path)")
+    ap.add_argument("--commitpipe-verifier", choices=("device", "sw"),
+                    default="device",
+                    help="commitpipe: signature backend for BOTH arms "
+                         "(sw = no XLA compile; the CPU smoke target)")
     ap.add_argument("--_worker", action="store_true",
                     help=argparse.SUPPRESS)
     args, _ = ap.parse_known_args()
@@ -949,6 +1192,9 @@ def main() -> int:
             argv += ["--inflight", str(args.inflight)]
         if args.precision is not None:
             argv += ["--precision", args.precision]
+        if metric == "commitpipe":
+            argv += ["--pipeline-depth", str(args.pipeline_depth),
+                     "--commitpipe-verifier", args.commitpipe_verifier]
         rc |= supervise(args, argv)
     return rc
 

@@ -260,6 +260,10 @@ def run_pipeline(n_txs: int, verifier, reps_unused: int = 1,
             if stats is not None:
                 stats["stage_secs"] = round(client.stage_secs, 3)
                 stats["commit_secs"] = round(client.commit_secs, 3)
+                # the device-verdict wait inside commit_secs — the
+                # part the pipeline hides under the next block's
+                # staging (commitpipe's await histogram, summed)
+                stats["await_secs"] = round(client.await_secs, 3)
                 stats["wall_secs"] = round(dt, 3)
             return n_txs / dt
         finally:

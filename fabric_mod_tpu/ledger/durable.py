@@ -315,6 +315,12 @@ class DurableStateDB:
             off, vlen, ver = self._keydir[(ns, key)]
             yield ns, key, self._read_value(off, vlen), ver
 
+    def iter_metadata(self):
+        """Deterministic full metadata scan: (ns, key, {name: value})
+        sorted (same contract as VersionedDB.iter_metadata)."""
+        for (ns, key) in sorted(self._metadata):
+            yield ns, key, dict(self._metadata[(ns, key)])
+
     def get_state_range(self, ns: str, start: str,
                         end: str) -> List[Tuple[str, bytes, Version]]:
         keys = self._keys.get(ns, [])

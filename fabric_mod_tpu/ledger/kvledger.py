@@ -603,6 +603,40 @@ class KvLedger:
         return None
 
     # -- queries ---------------------------------------------------------
+    def state_fingerprint(self) -> str:
+        """Deterministic digest of the ENTIRE committed state: every
+        (ns, key, value, version) row plus every key-metadata entry
+        (VALIDATION_PARAMETER included) plus the chain height.  Two
+        ledgers that committed the same blocks with the same verdicts
+        agree bit-for-bit — the commit-pipeline differential's
+        equality oracle (bench.py --metric commitpipe,
+        tests/test_commitpipe.py)."""
+        import hashlib
+        h = hashlib.sha256()
+        h.update(self.height.to_bytes(8, "big"))
+
+        def upd(b: bytes) -> None:
+            h.update(len(b).to_bytes(4, "big"))
+            h.update(b)
+        for ns, key, value, ver in self.state.iter_state():
+            upd(ns.encode())
+            upd(key.encode())
+            upd(value)
+            h.update(ver[0].to_bytes(8, "big") + ver[1].to_bytes(8, "big"))
+        # section marker + per-key entry COUNT keep the encoding
+        # injective: without them a key with 3 metadata entries and a
+        # key with 1 entry followed by another (ns, key) pair could
+        # hash to the same byte stream
+        h.update(b"\x00METADATA\x00")
+        for ns, key, entries in self.state.iter_metadata():
+            upd(ns.encode())
+            upd(key.encode())
+            h.update(len(entries).to_bytes(4, "big"))
+            for name in sorted(entries):
+                upd(name.encode())
+                upd(entries[name])
+        return h.hexdigest()
+
     @property
     def height(self) -> int:
         return self.blockstore.height

@@ -83,6 +83,14 @@ class VersionedDB:
             value, ver = self._data[(ns, key)]
             yield ns, key, value, ver
 
+    def iter_metadata(self):
+        """Deterministic full metadata scan: (ns, key, {name: value})
+        sorted — the state-fingerprint oracle's input (part of the DB
+        interface so a storage change can't silently drop metadata
+        from the digest)."""
+        for (ns, key) in sorted(self._metadata):
+            yield ns, key, dict(self._metadata[(ns, key)])
+
     def get_state_range(self, ns: str, start: str,
                         end: str) -> List[Tuple[str, bytes, Version]]:
         """(key, value, version) list, start <= key < end ('' end =

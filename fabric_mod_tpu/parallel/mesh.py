@@ -15,6 +15,15 @@ batch is the only parallel axis; nothing rides ICI except the result).
 
 Multi-host later: the same mesh spec over jax.distributed processes;
 the sharding annotations do not change.
+
+A SECOND, host-side parallel axis composes with the mesh since the
+commit pipeline landed (peer/commitpipe.py): with pipeline depth >= 2,
+block N's verify batch is in flight on the mesh while block N+1's host
+staging marshals the next batch — so the dp axis sees back-to-back
+dispatches instead of host-gap bubbles.  Nothing here changes for
+that: both in-flight batches carry the same NamedShardings; the
+overlap is purely dispatch-order (XLA queues per-device programs
+FIFO), which is why the pipeline needs no device-side coordination.
 """
 from __future__ import annotations
 

@@ -44,6 +44,10 @@ class Channel:
         self._csp = csp
         self._plugin_registry = plugin_registry
         self._lock = threading.Lock()
+        self._commit_pipe = None           # lazy; see commit_pipeline()
+        # serializes pipe (re)builds: never held by pipe worker
+        # threads, so the unbounded drain-join inside cannot deadlock
+        self._pipe_rebuild_lock = threading.Lock()
         if vinfo is None:
             # lifecycle-backed: committed chaincode definitions resolve
             # each namespace's endorsement policy (peer/lifecycle.py)
@@ -177,9 +181,73 @@ class Channel:
     # -- commit path ------------------------------------------------------
     def store_block(self, block: m.Block) -> List[int]:
         """validate -> MVCC -> commit (the reference's coordinator
-        StoreBlock composition, gossip/state/state.go:817)."""
+        StoreBlock composition, gossip/state/state.go:817).
+
+        With FABRIC_MOD_TPU_COMMIT_PIPELINE set, the commit routes
+        through the channel's shared PipelinedCommitter: this call is
+        still synchronous (waits for THIS block's commit, returns its
+        final flags), but overlapping callers pipeline — stage(N+1)
+        proceeds while commit(N) runs."""
+        pipe = self.commit_pipeline()
+        if pipe is not None:
+            try:
+                return pipe.store_block(block)
+            except Exception:
+                # the failure may be INHERITED — a pipe another
+                # caller's block poisoned (sticky error) or closed
+                # under us mid-rebuild.  One retry through a fresh
+                # pipe separates that from this block's own error:
+                # an own-error block fails again with the real cause,
+                # and a gate rejection returns the SAME healthy pipe
+                # so we re-raise without a pointless resubmit.
+                retry = self.commit_pipeline()
+                if retry is None or retry is pipe:
+                    raise
+                return retry.store_block(block)
         flags = self.validator().validate(block)
         return self.ledger.commit_block(block, flags)
+
+    def commit_pipeline(self):
+        """The channel's shared PipelinedCommitter when the
+        FABRIC_MOD_TPU_COMMIT_PIPELINE knob enables one, else None.
+        Shared so every commit producer on this channel (gossip drain,
+        store_block callers) feeds ONE in-order pipeline.
+
+        A failed pipeline is sticky only until its error has been
+        surfaced: the caller that hit it gets the exception (from
+        submit/wait), and the next call here discards the poisoned
+        pipe and builds a fresh one from the committed height — the
+        retry semantics the synchronous path always had (one bad
+        block never bricks the channel).  The rebuild fully drains
+        the old engine FIRST (unbounded close, outside self._lock so
+        an in-flight config_apply can still take it) — two engines
+        never run against the ledger at once."""
+        from fabric_mod_tpu.peer.commitpipe import pipeline_depth
+        depth = pipeline_depth()
+        if depth <= 0:
+            return None
+        def healthy():
+            with self._lock:
+                pipe = self._commit_pipe
+            return pipe if (pipe is not None and pipe.error is None
+                            and not pipe.closed) else None
+        pipe = healthy()
+        if pipe is not None:
+            return pipe                    # hot path: no rebuild lock
+        with self._pipe_rebuild_lock:
+            pipe = healthy()
+            if pipe is not None:
+                return pipe                # another caller rebuilt
+            with self._lock:
+                old, self._commit_pipe = self._commit_pipe, None
+            if old is not None:
+                old.close()                # join until the engine died
+            from fabric_mod_tpu.peer.commitpipe import PipelinedCommitter
+            pipe = PipelinedCommitter(self, depth=depth,
+                                      consumer="channel")
+            with self._lock:
+                self._commit_pipe = pipe
+            return pipe
 
     # pipelined split: stage (host unpack + async device dispatch) may
     # run ahead of the previous block's commit; commit_staged resolves

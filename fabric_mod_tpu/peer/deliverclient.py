@@ -9,30 +9,32 @@ Three pipeline stages — the double buffer of SURVEY §2.9 row 2:
 
   stage 1 (this thread / `run`):   pull block N+2, hash-check + verify
                                    its orderer signature
-  stage 2 (stage worker thread):   host unpack + policy staging of
+  stage 2 (pipeline stage loop):   host unpack + policy staging of
                                    block N+1, then DISPATCH its device
                                    verify batch without awaiting it
-  stage 3 (commit worker thread):  await block N's device verdicts,
+  stage 3 (pipeline commit loop):  await block N's device verdicts,
                                    resolve flags, MVCC + commit
 
+Stages 2+3 are peer/commitpipe.PipelinedCommitter — the shared
+commit-pipeline engine (bounded depth, `needs_barrier` drains,
+per-stage histograms); this client owns stage 1 and the MCS gate.
 Block N+1's host unmarshalling overlaps block N's device execution:
 the device batch is in flight between stage 2's dispatch and stage
-3's resolve.  Bounded in-order queues between stages are the payload
-buffer; commit order is block-number order by construction (single
-puller).  Staging must not run ahead of a block that changes what
-staging reads — config txs, VALIDATION_PARAMETER writes, lifecycle
-definitions — so such blocks set `needs_barrier` and stage 2 waits
-for their commit before staging the next block (the reference's
-serialization points: validator.go:400 config, validator_keylevel.go
-waits).
+3's resolve.  Commit order is block-number order by construction
+(single puller).  Staging must not run ahead of a block that changes
+what staging reads — config txs, VALIDATION_PARAMETER writes,
+lifecycle definitions — so such blocks set `needs_barrier` and the
+engine waits for their commit before staging the next block (the
+reference's serialization points: validator.go:400 config,
+validator_keylevel.go waits).
 """
 from __future__ import annotations
 
-import queue
 import threading
 from typing import Callable, List, Optional
 
 from fabric_mod_tpu.peer.channel import Channel
+from fabric_mod_tpu.peer.commitpipe import PipelinedCommitter, pipeline_depth
 from fabric_mod_tpu.peer.mcs import BlockVerificationError
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
@@ -49,90 +51,65 @@ class DeliverClient:
     def __init__(self, channel: Channel, source,
                  queue_size: int = 8,
                  on_error: Optional[Callable[[Exception], None]] = None,
-                 on_commit: Optional[Callable[[m.Block], None]] = None):
+                 on_commit: Optional[Callable[[m.Block], None]] = None,
+                 depth: Optional[int] = None):
         """`on_commit(block)` fires after each commit — the gossip
         service uses it to fan committed blocks out to non-leader
-        peers (reference: the leader's gossip of deliver payloads)."""
+        peers (reference: the leader's gossip of deliver payloads).
+        `depth` bounds staged-but-uncommitted blocks; default: the
+        FABRIC_MOD_TPU_COMMIT_PIPELINE knob, else 2 (the double
+        buffer this client has always run)."""
         self._channel = channel
         self._source = source
-        self._q: "queue.Queue[Optional[m.Block]]" = queue.Queue(queue_size)
-        # staged (dispatched, unresolved) blocks; small: each entry
-        # holds a device batch in flight — 2 is the double buffer
-        self._staged_q: "queue.Queue" = queue.Queue(2)
-        self._stop = threading.Event()
-        self._on_error = on_error
         self._on_commit = on_commit
+        self._stop = threading.Event()
+        self._depth = depth if depth is not None else \
+            (pipeline_depth() or 2)
+        self._queue_size = queue_size
+        self._on_error = on_error
+        # stage/commit seconds of pipes already closed (run() builds a
+        # fresh engine per invocation — the client is reusable)
+        self._secs_base = [0.0, 0.0, 0.0]  # stage, await, commit
+        self._pipe = self._make_pipe()
         self.rejected: List[int] = []      # block numbers that failed MCS
-        # cumulative wall seconds per stage (the e2e bench reports
-        # these to show the verify-vs-commit overlap)
-        self.stage_secs = 0.0
-        self.commit_secs = 0.0
-        self._commit_err: Optional[Exception] = None
-        self._committed = threading.Condition()
-        self._height = channel.ledger.height
 
-    def _fail(self, e: Exception) -> None:
-        self._commit_err = e
-        self._stop.set()
-        if self._on_error is not None:
-            self._on_error(e)
+    def _make_pipe(self) -> PipelinedCommitter:
+        def fail(e: Exception) -> None:
+            # stop the pull promptly: the source generator honors the
+            # stop event, so a dead pipeline doesn't pull until idle
+            self._stop.set()
+            if self._on_error is not None:
+                self._on_error(e)
 
-    # -- stage 2: host unpack + device dispatch --------------------------
-    def _stage_loop(self) -> None:
-        import time as _time
-        try:
-            while True:
-                block = self._q.get()
-                if block is None:
-                    return
-                t0 = _time.perf_counter()
-                staged = self._channel.stage_block(block)
-                self.stage_secs += _time.perf_counter() - t0
-                barrier = staged.needs_barrier
-                self._staged_q.put(staged)
-                if barrier:
-                    # this block changes state that staging reads:
-                    # wait for its commit before staging the next one
-                    want = block.header.number + 1
-                    with self._committed:
-                        while (self._height < want
-                               and not self._stop.is_set()
-                               and self._commit_err is None):
-                            self._committed.wait(timeout=0.5)
-        except Exception as e:
-            self._fail(e)
-            # keep draining so the puller's bounded put never deadlocks
-            while self._q.get() is not None:
-                pass
-        finally:
-            self._staged_q.put(None)
+        return PipelinedCommitter(
+            self._channel, depth=self._depth,
+            in_queue=self._queue_size,
+            on_commit=self._handle_commit, on_error=fail,
+            consumer="deliver")
 
-    # -- stage 3: the commit worker --------------------------------------
-    def _commit_loop(self) -> None:
-        import time as _time
-        while True:
-            staged = self._staged_q.get()
-            if staged is None:
-                return
+    def _handle_commit(self, block: m.Block, _flags) -> None:
+        if self._on_commit is not None:
             try:
-                t0 = _time.perf_counter()
-                self._channel.commit_staged(staged)
-                self.commit_secs += _time.perf_counter() - t0
-            except Exception as e:
-                self._fail(e)
-                # drain so the stage worker's bounded put never blocks
-                while self._staged_q.get() is not None:
-                    pass
-                return
-            block = staged.block
-            with self._committed:
-                self._height = block.header.number + 1
-                self._committed.notify_all()
-            if self._on_commit is not None:
-                try:
-                    self._on_commit(block)
-                except Exception:          # gossip fan-out is advisory
-                    pass
+                self._on_commit(block)
+            except Exception:              # gossip fan-out is advisory
+                pass
+
+    # cumulative wall seconds per stage (the e2e bench reports these
+    # to show the verify-vs-commit overlap); commit_secs keeps the old
+    # meaning — everything after dispatch: verdict await + resolve +
+    # MVCC + ledger commit
+    @property
+    def stage_secs(self) -> float:
+        return self._secs_base[0] + self._pipe.stage_secs
+
+    @property
+    def await_secs(self) -> float:
+        return self._secs_base[1] + self._pipe.await_secs
+
+    @property
+    def commit_secs(self) -> float:
+        return (self._secs_base[1] + self._secs_base[2]
+                + self._pipe.await_secs + self._pipe.commit_secs)
 
     # -- stage 1: pull + verify ------------------------------------------
     def run(self, stop_at: Optional[int] = None,
@@ -140,15 +117,19 @@ class DeliverClient:
         """Pull from the ledger's current height until `stop_at` (block
         number, inclusive) or the source goes idle.  Blocking; callers
         wanting a background client wrap this in a thread."""
+        if self._pipe.closed:
+            # reusable client (the pre-engine contract): each run()
+            # gets fresh workers; prior runs' timings accumulate
+            self._secs_base[0] += self._pipe.stage_secs
+            self._secs_base[1] += self._pipe.await_secs
+            self._secs_base[2] += self._pipe.commit_secs
+            self._pipe = self._make_pipe()
+            self._stop.clear()
         start = self._channel.ledger.height
         prev_hash = None
         if start > 0:
             prev = self._channel.ledger.get_block_by_number(start - 1)
             prev_hash = protoutil.block_header_hash(prev.header)
-        stager = threading.Thread(target=self._stage_loop, daemon=True)
-        stager.start()
-        worker = threading.Thread(target=self._commit_loop, daemon=True)
-        worker.start()
         try:
             for block in self._source.blocks(
                     start, stop=stop_at, stop_event=self._stop,
@@ -175,19 +156,36 @@ class DeliverClient:
                         continue
                     break
                 prev_hash = protoutil.block_header_hash(block.header)
-                self._q.put(block)
+                try:
+                    self._pipe.submit(block)
+                except Exception:
+                    if self._pipe.error is None:
+                        raise              # not a pipeline failure
+                    break                  # re-raised after close below
         finally:
-            self._q.put(None)
-            stager.join()
-            worker.join()
-        if self._commit_err is not None:
-            raise self._commit_err
+            # unbounded join (the pre-engine contract): run() never
+            # returns with commits silently in flight, however long
+            # the tail block's cold XLA compile takes
+            self._pipe.close()
+        if self._pipe.error is not None:
+            raise self._pipe.error
 
     def stop(self) -> None:
         self._stop.set()
 
     def wait_for_height(self, height: int, timeout_s: float = 30.0) -> bool:
-        """Block until `height` blocks are committed."""
-        with self._committed:
-            return self._committed.wait_for(
-                lambda: self._height >= height, timeout=timeout_s)
+        """Block until `height` blocks are committed.  Re-reads the
+        pipe each slice: a reused client swaps in a fresh engine per
+        run(), and a waiter must follow it rather than watch a closed
+        pipe whose height never advances."""
+        import time
+        deadline = time.monotonic() + timeout_s
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return False
+            try:
+                if self._pipe.wait_height(height, min(left, 1.0)):
+                    return True
+            except Exception:
+                return False

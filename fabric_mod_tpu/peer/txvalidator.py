@@ -135,13 +135,22 @@ class StagedBlock:
     """A block after passes 1+2: host staging done, device batch
     dispatched, verdicts pending (resolved by TxValidator.finish)."""
 
-    __slots__ = ("block", "validator", "works", "mask_fn")
+    __slots__ = ("block", "validator", "works", "mask_fn", "_mask")
 
     def __init__(self, block, validator, works, mask_fn):
         self.block = block
         self.validator = validator
         self.works = works
         self.mask_fn = mask_fn
+        self._mask = None
+
+    def resolve_mask(self):
+        """Await the device verdicts (idempotent).  The commit
+        pipeline calls this under its own await-latency histogram;
+        `finish` then reads the cached mask for free."""
+        if self._mask is None:
+            self._mask = self.mask_fn()
+        return self._mask
 
     @property
     def needs_barrier(self) -> bool:
@@ -410,7 +419,7 @@ class TxValidator:
         application happen in block order so later txs see exactly the
         effects of earlier VALID ones."""
         block, works = staged.block, staged.works
-        mask = staged.mask_fn()
+        mask = staged.resolve_mask()
         flags: List[int] = []
         seen_txids = set()
         applied_vp: Dict[tuple, int] = {}   # (ns, key) -> writer tx_idx
@@ -478,7 +487,12 @@ class TxValidator:
 class Committer:
     """Validate + MVCC + commit, the peer's StoreBlock composition
     (reference: gossip/state/state.go:817 commitBlock ->
-    coordinator StoreBlock -> validator -> kvledger CommitLegacy)."""
+    coordinator StoreBlock -> validator -> kvledger CommitLegacy).
+
+    Strictly serial: block N+1's staging starts only after block N's
+    commit returns.  peer/commitpipe.PipelinedCommitter is the
+    overlapped version of this composition (and collapses to exactly
+    this behavior at depth=1)."""
 
     def __init__(self, validator: TxValidator, ledger):
         self.validator = validator

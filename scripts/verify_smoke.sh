@@ -1,18 +1,25 @@
 #!/usr/bin/env bash
-# CPU smoke target for the verify pipeline: the mixed-ladder verdict
-# differential (incl. the fused-hash raw-vs-digest check) plus the
-# fused hash->verify A/B, both on the CPU backend with a small batch —
-# a wheel-less container can run this in a few minutes, no TPU needed.
+# CPU smoke target for the verify + commit pipeline:
+#   1. the mixed-ladder verdict differential (incl. the fused-hash
+#      raw-vs-digest check)
+#   2. the fused hash->verify A/B
+#   3. the commit-pipeline differential: pipelined-vs-sync committed
+#      blocks with mixed barrier/non-barrier streams, asserting
+#      per-block txflags + final state-hash identity (sw verifier so
+#      no XLA compile — the identity assertion runs on every change)
+# all on the CPU backend with a small batch — a wheel-less container
+# can run this in a few minutes, no TPU needed.
 #
 #   scripts/verify_smoke.sh              # defaults (batch 64)
 #   SMOKE_BATCH=256 scripts/verify_smoke.sh
 #
-# Exit status is nonzero if any verdict differential reports a
-# mismatch (bench.py propagates per-metric rc).
+# Exit status is nonzero if any verdict differential or the commitpipe
+# identity assertion fails (bench.py propagates per-metric rc).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 # CPU XLA compiles of the verify cores run multiple minutes each (the
 # persistent compile cache is TPU-oriented); give the worker room.
 export FABRIC_MOD_TPU_BENCH_TIMEOUT="${FABRIC_MOD_TPU_BENCH_TIMEOUT:-2400}"
 exec python bench.py --cpu --batch "${SMOKE_BATCH:-64}" --reps 1 \
-    --metric diffverify --metric hashverify
+    --metric diffverify --metric hashverify \
+    --metric commitpipe --commitpipe-verifier sw
