@@ -719,7 +719,11 @@ def measure_idemix(n: int, reps: int) -> tuple:
 
     t0 = time.perf_counter()
     got = idx.batch_verify(ik, items, use_device=True)  # incl. compile
-    log(f"idemix warm-up (incl. compile): {time.perf_counter() - t0:.1f}s")
+    compile_secs = time.perf_counter() - t0
+    log(f"idemix warm-up (incl. compile): {compile_secs:.1f}s — the "
+        f"pairing program sits on the persistent XLA cache "
+        f"(ops/compilecache.py), so a cached run shows ~steady-state "
+        f"time here")
     if got != expect:
         bad_idx = [i for i, (g, e) in enumerate(zip(got, expect)) if g != e]
         raise AssertionError(f"idemix device verdicts wrong at {bad_idx}")
@@ -727,8 +731,12 @@ def measure_idemix(n: int, reps: int) -> tuple:
     for _ in range(reps):
         idx.batch_verify(ik, items, use_device=True)
     dev_rate = n * reps / (time.perf_counter() - t0)
+    steady = n / dev_rate
     log(f"device idemix: {dev_rate:,.1f} presentations/s")
-    return dev_rate, sw_rate
+    # compile cost ≈ warm-up minus one steady-state batch; recorded so
+    # the artifact shows whether the persistent cache held (VERDICT #8:
+    # a second run must show ~0)
+    return dev_rate, sw_rate, max(0.0, compile_secs - steady)
 
 
 def measure_gossip(n_peers: int, reps: int) -> tuple:
@@ -799,9 +807,17 @@ def measure_gossip(n_peers: int, reps: int) -> tuple:
         sw_rate = storm(FakeBatchVerifier(SwCSP()).verify_many)
         log(f"sw gossip storm: {sw_rate:,.1f} block-verifies/s")
         dev = BatchingVerifyService(TpuVerifier())
-        # unbounded future wait: the cold bucket compile exceeds the
-        # service's default 30 s verdict timeout
-        dev_verify = lambda items: dev.verify_many(items, timeout=None)
+        # BOUNDED wait sized to the worker's own kill budget (the old
+        # `timeout=None` workaround outlived its cause: the verify
+        # bucket programs sit on the persistent compile cache, and the
+        # supervisor's process-group timeout is the real backstop —
+        # an unbounded Future wait could only turn a wedged device
+        # into a silent hang).  The default matches verify_smoke.sh's
+        # export: a COLD CPU compile of the verify cores runs multiple
+        # minutes, and the first storm call carries it whole
+        budget = float(os.environ.get("FABRIC_MOD_TPU_BENCH_TIMEOUT",
+                                      "2400"))
+        dev_verify = lambda items: dev.verify_many(items, timeout=budget)
         try:
             storm(dev_verify)                 # warm-up/compile
             dev_rate = storm(dev_verify)
@@ -927,13 +943,15 @@ def run_worker(args) -> int:
         }
     elif args.metric == "idemix":
         # n presentations bounded: host signing dominates setup
-        dev_rate, sw_rate = measure_idemix(min(args.batch, 64),
-                                           max(1, min(args.reps, 2)))
+        dev_rate, sw_rate, compile_secs = measure_idemix(
+            min(args.batch, 64), max(1, min(args.reps, 2)))
         out = {
             "metric": "idemix_presentations_per_sec",
             "value": round(dev_rate, 1),
             "unit": "presentations/s",
             "vs_baseline": round(dev_rate / sw_rate, 3),
+            # ~0 on a warm persistent cache (VERDICT #8's "done" bar)
+            "compile_secs": round(compile_secs, 1),
         }
     elif args.metric == "gossip":
         dev_rate, sw_rate = measure_gossip(50, max(1, args.reps))

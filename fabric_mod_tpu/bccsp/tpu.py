@@ -55,24 +55,17 @@ import numpy as np
 from fabric_mod_tpu.bccsp.api import BCCSP, Key, VerifyItem
 from fabric_mod_tpu.bccsp import der as _der
 from fabric_mod_tpu.bccsp import sw as _sw
+from fabric_mod_tpu.concurrency import (GuardedQueue, RegisteredLock,
+                                        RegisteredThread, assert_joined)
 from fabric_mod_tpu.observability.metrics import (MetricOpts,
                                                   default_provider)
 
 # Persistent XLA compilation cache: the ECDSA ladder costs tens of
-# seconds to compile; cache it across processes.
-def _enable_compile_cache() -> None:
-    try:
-        import jax
-        cache_dir = os.environ.get(
-            "FABRIC_MOD_TPU_JIT_CACHE",
-            os.path.expanduser("~/.cache/fabric_mod_tpu/jit"))
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
-
+# seconds to compile; cache it across processes.  (Shared helper —
+# ops/fp256bn_dev.py puts the idemix pairing program on the same
+# cache at its import.)
+from fabric_mod_tpu.ops.compilecache import (  # noqa: E402
+    enable_compile_cache as _enable_compile_cache)
 
 _enable_compile_cache()
 
@@ -445,19 +438,29 @@ class BatchingVerifyService:
             inflight_depth = int(os.environ.get(
                 "FABRIC_MOD_TPU_INFLIGHT", "2"))
         self.inflight_depth = max(1, inflight_depth)
-        self._q: "queue.Queue[tuple[VerifyItem, Future]]" = queue.Queue()
-        self._inflight: "queue.Queue" = queue.Queue(
-            maxsize=self.inflight_depth)
+        # submit queue: many producers (any caller), ONE consumer (the
+        # flusher worker); in-flight queue: strict SPSC worker ->
+        # resolver.  Both contracts are machine-checked under
+        # FMT_RACECHECK — the round-5 verdict named this flusher the
+        # structure most likely to hide a real race.
+        self._q: "GuardedQueue" = GuardedQueue(name="verify-submit")
+        self._inflight: "GuardedQueue" = GuardedQueue(
+            self.inflight_depth, name="verify-inflight",
+            single_producer=True)
         self._stop = threading.Event()
-        self._lifecycle = threading.Lock()   # serializes submit vs close
+        # serializes submit vs close; registry-fed for cycle detection
+        self._lifecycle = RegisteredLock("verify-service-lifecycle")
         prov = default_provider()
         self._batch_hist = prov.histogram(
             _SERVICE_BATCH_OPTS, buckets=(1, 8, 64, 256, 512, 1024, 2048))
         self._inflight_gauge = prov.gauge(_SERVICE_INFLIGHT_OPTS)
-        self._resolver = threading.Thread(target=self._resolve_loop,
-                                          daemon=True)
+        self._resolver = RegisteredThread(target=self._resolve_loop,
+                                          name="verify-resolver",
+                                          structure="BatchingVerifyService")
         self._resolver.start()
-        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker = RegisteredThread(target=self._run,
+                                        name="verify-flusher",
+                                        structure="BatchingVerifyService")
         self._worker.start()
 
     def submit(self, item: VerifyItem) -> Future:
@@ -500,16 +503,28 @@ class BatchingVerifyService:
         verdict — callers may be blocked on their Futures."""
         with self._lifecycle:
             self._stop.set()
-        self._worker.join(timeout=30)
-        self._resolver.join(timeout=30)
-        # A submit may have raced the worker's final drain; fail any
-        # stragglers rather than leaving callers hung.
-        while True:
-            try:
-                _, fut = self._q.get_nowait()
-            except queue.Empty:
-                break
-            fut.set_exception(RuntimeError("verify service is closed"))
+        try:
+            # leak-checked teardown: a worker/resolver that survives
+            # the join is a race report, not a silent daemon park
+            assert_joined((self._worker, self._resolver),
+                          owner="BatchingVerifyService", timeout=30)
+        finally:
+            # A submit may have raced the worker's final drain; fail
+            # any stragglers rather than leaving callers hung — even
+            # when the join raised (a caller parked on a raced Future
+            # must not block forever behind the race report).  When
+            # the join raised the worker may still be ALIVE, so the
+            # consumer pin must be released explicitly or the drain
+            # itself would raise a second RaceError, mask the leak
+            # report, and leave the stragglers unresolved.
+            self._q.release_consumer()
+            while True:
+                try:
+                    _, fut = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                fut.set_exception(
+                    RuntimeError("verify service is closed"))
 
     # -- worker side: accumulate + dispatch -------------------------------
 

@@ -1,0 +1,64 @@
+"""Concurrency-correctness subsystem: guarded primitives + dynamic
+race detection for every threaded structure in the framework.
+
+(reference: scripts/run-unit-tests.sh:142-161 runs the WHOLE Go unit
+suite under the race detector.  Python has no ``-race``; this package
+is the library-level answer in the style of ThreadSanitizer's dynamic
+annotations (Serebryany & Iskhodzhanov, 2009) and FastTrack's
+ownership/happens-before discipline (Flanagan & Freund, 2009):
+instrument the primitives once, retrofit the consumers, run the whole
+suite under ``FMT_RACECHECK=1``.)
+
+The primitive catalog:
+
+* ``OrderedLock``      — ranked lock hierarchy (always on; the
+                         original utils/racecheck.py detector), now
+                         also feeding the lock-order registry.
+* ``RegisteredLock``   — rank-less mutex that records every observed
+                         acquisition ordering into a process-wide
+                         graph; the moment a SECOND ordering closes a
+                         cycle (the AB/BA deadlock shape, across any
+                         number of locks and threads) it raises
+                         ``RaceError`` at acquire time.
+* ``GuardedQueue``     — queue.Queue whose consumer side (and
+                         optionally producer side) is pinned to one
+                         owning thread; ownership transfers only from
+                         a DEAD thread (join is the happens-before
+                         edge, as in FastTrack).
+* ``OwnedState``       — field-level thread-ownership wrapper: writes
+                         are pinned to the owning thread, reads stay
+                         open; ``claim()/release()`` give scoped
+                         exclusivity (two concurrent ``run()`` loops
+                         on one client is a race, not a feature).
+* ``ThreadOwnership``  — whole-structure pin (the raft FSM contract).
+* ``RegisteredThread`` — named worker thread registered in a
+                         process-wide set; ``assert_joined`` makes a
+                         structure's teardown fail loudly when its
+                         workers leak.
+
+Cost model: with ``FMT_RACECHECK`` unset every guard is a single
+module-flag read (the queues/locks degrade to their plain stdlib
+behavior); with it set, the whole tier-1 suite runs with every guard
+armed and tests/test_racecheck.py's injected-race canaries prove each
+one bites.
+"""
+from fabric_mod_tpu.concurrency.core import (RaceError, armed, enable,
+                                             enabled)
+from fabric_mod_tpu.concurrency.locks import (LockOrderRegistry,
+                                              OrderedLock,
+                                              RegisteredLock,
+                                              lock_registry)
+from fabric_mod_tpu.concurrency.ownership import (OwnedState,
+                                                  ThreadOwnership)
+from fabric_mod_tpu.concurrency.queues import GuardedQueue
+from fabric_mod_tpu.concurrency.threads import (RegisteredThread,
+                                                assert_joined,
+                                                live_registered)
+
+__all__ = [
+    "RaceError", "enabled", "enable", "armed",
+    "OrderedLock", "RegisteredLock", "LockOrderRegistry",
+    "lock_registry",
+    "GuardedQueue", "OwnedState", "ThreadOwnership",
+    "RegisteredThread", "assert_joined", "live_registered",
+]

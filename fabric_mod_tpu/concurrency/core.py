@@ -1,0 +1,57 @@
+"""The arming gate + shared per-thread lock bookkeeping.
+
+``FMT_RACECHECK`` (any value but ""/"0") arms every guard in the
+package at import time; ``enable()``/``armed()`` flip it at runtime
+(the canary tests prove each guard raises when armed and is silent
+when off).  The held-lock stack is shared between ``OrderedLock`` and
+``RegisteredLock`` so ordering edges are observed across BOTH kinds —
+an inversion between a ranked ledger lock and a rank-less gossip lock
+is still a cycle.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+
+class RaceError(AssertionError):
+    """A detected race/ordering violation (AssertionError so test
+    frameworks treat it as a hard failure, never a skip)."""
+
+
+_enabled = os.environ.get("FMT_RACECHECK", "") not in ("", "0")
+
+
+def enabled() -> bool:
+    """Whether the FMT_RACECHECK guards are armed."""
+    return _enabled
+
+
+def enable(on: bool) -> None:
+    """Arm/disarm at runtime (tests; production uses the env var)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+@contextlib.contextmanager
+def armed(on: bool = True):
+    """Scoped enable/disable — the canary tests' toggle."""
+    global _enabled
+    prev = _enabled
+    _enabled = bool(on)
+    try:
+        yield
+    finally:
+        _enabled = prev
+
+
+_tls = threading.local()
+
+
+def held_locks() -> list:
+    """This thread's stack of (rank_or_None, lock) acquisitions."""
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h

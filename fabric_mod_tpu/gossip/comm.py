@@ -14,8 +14,10 @@ exactly what the reference's handshake establishes.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
+from fabric_mod_tpu.concurrency import (GuardedQueue, RegisteredLock,
+                                        RegisteredThread, assert_joined)
 from fabric_mod_tpu.protos import messages as m
 
 Handler = Callable[[bytes, bytes], None]     # (src_pki_id, envelope bytes)
@@ -129,20 +131,22 @@ class GRPCGossipNetwork:
         sender that differs from the authenticated one is dropped."""
         import base64
         import json
-        import queue
         from fabric_mod_tpu.comm.grpc_comm import (
             GRPCClient, GRPCServer, MethodKind)
         self._b64 = base64.b64encode
         self._unb64 = base64.b64decode
         self._json = json
-        self._queue_mod = queue
         self._GRPCClient = GRPCClient
         self._client_tls = (client_ca, client_cert, client_key)
         self._timeout = send_timeout_s
         self._auth = auth
         self._my_tls_hash = (_pem_cert_der_hash(client_cert)
                              if client_cert is not None else b"")
-        self._lock = threading.Lock()
+        # registry-fed mutex: the comm lock nests inside callers'
+        # locks (gossip node, discovery) — an inversion is a real
+        # deadlock and the registry reports the first one observed
+        self._lock = RegisteredLock("gossip.comm")
+        self._senders: List[RegisteredThread] = []
         self._stopped = threading.Event()
         self._handlers: Dict[str, Handler] = {}
         self._clients: Dict[str, object] = {}
@@ -171,6 +175,7 @@ class GRPCGossipNetwork:
             clients = list(self._clients.values())
             self._clients.clear()
             queues = list(self._queues.values())
+            senders, self._senders = self._senders, []
         for q in queues:
             try:
                 q.put_nowait(None)
@@ -179,6 +184,16 @@ class GRPCGossipNetwork:
         for c in clients:
             c.close()
         self.server.stop()
+        # leak check: every per-destination sender must terminate.
+        # An IDLE sender wakes within its 0.5 s poll slice, but one
+        # mid-send against an unresponsive peer can legitimately chain
+        # handshake hello + auth + send + NACK token-drop + re-
+        # handshake + resend (up to ~6 unary calls, each bounded by
+        # send_timeout_s) before re-checking _stopped — derive the
+        # budget from the knob so clean teardown never raises a false
+        # leak at any configured timeout
+        assert_joined(senders, owner="gossip.comm",
+                      timeout=max(15.0, 6 * self._timeout + 1.0))
 
     # -- the network surface ---------------------------------------------
     def register(self, endpoint: str, handler: Handler) -> None:
@@ -220,11 +235,18 @@ class GRPCGossipNetwork:
         with self._lock:
             q = self._queues.get(endpoint)
             if q is None:
-                q = self._queue_mod.Queue(self.QUEUE_CAP)
+                # consumer side pinned to the sender thread: any other
+                # thread draining a destination's queue would reorder
+                # or steal its traffic — a race, caught at the get
+                q = GuardedQueue(self.QUEUE_CAP,
+                                 name=f"gossip-send[{endpoint}]")
                 self._queues[endpoint] = q
-                threading.Thread(target=self._sender,
-                                 args=(endpoint, q),
-                                 daemon=True).start()
+                t = RegisteredThread(target=self._sender,
+                                     name=f"gossip-send[{endpoint}]",
+                                     structure="gossip.comm",
+                                     args=(endpoint, q))
+                self._senders.append(t)
+                t.start()
             return q
 
     def _sender(self, endpoint: str, q) -> None:

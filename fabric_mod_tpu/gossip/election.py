@@ -18,6 +18,11 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
+from fabric_mod_tpu import concurrency as _cc
+from fabric_mod_tpu.concurrency import (RegisteredLock,
+                                        RegisteredThread, ThreadOwnership,
+                                        assert_joined)
+
 
 class LeaderElectionService:
     def __init__(self, pki_id: bytes, alive_pki_ids_fn,
@@ -28,9 +33,17 @@ class LeaderElectionService:
         self._on_change = on_change
         self._static = static
         self._is_leader = bool(static) if static is not None else False
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("election")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # once start()'s loop runs, IT owns ticking: an external
+        # tick() racing the loop can fire on_change transitions out
+        # of order (the verdict flips back and forth but callbacks
+        # land swapped).  Manual tick() on an un-started service
+        # (tests, static mode) stays legal; after stop() the dead
+        # loop thread releases ownership.
+        self._ticker = ThreadOwnership("election-ticker",
+                                       live_only=True)
 
     @property
     def is_leader(self) -> bool:
@@ -40,6 +53,8 @@ class LeaderElectionService:
     def tick(self) -> bool:
         """Recompute leadership; fires on_change on transitions.
         Returns the current verdict."""
+        if _cc.enabled():
+            self._ticker.guard()
         if self._static is not None:
             return self._is_leader
         candidates = [self._pki] + list(self._alive())
@@ -55,12 +70,16 @@ class LeaderElectionService:
 
     def start(self, interval_s: float = 1.0) -> None:
         def loop():
+            self._ticker.claim()           # the loop owns ticking now
             while not self._stop.wait(interval_s):
                 self.tick()
-        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread = RegisteredThread(target=loop,
+                                        name="election-loop",
+                                        structure="LeaderElectionService")
         self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            assert_joined((self._thread,),
+                          owner="LeaderElectionService", timeout=5)

@@ -66,8 +66,13 @@ class GossipService:
         # NON-leader's gossip receipts into commits — the service owns
         # it so every composed peer commits regardless of leadership
         self._node.state.start()
+        # immediate first verdict BEFORE the loop spawns: once the
+        # election loop runs, it owns ticking (concurrency.ThreadOwnership
+        # — an external tick racing the loop can deliver on_change
+        # transitions out of order, so the old start-then-tick order
+        # was a real, now machine-checked, race)
+        self.election.tick()
         self.election.start(self._interval)
-        self.election.tick()               # immediate first verdict
         # the static-leader path never fires on_change (leadership is
         # fixed from construction) — start the client directly
         if self.election.is_leader:

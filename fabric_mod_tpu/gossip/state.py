@@ -24,6 +24,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from fabric_mod_tpu.concurrency import (RegisteredLock,
+                                        RegisteredThread, assert_joined)
 from fabric_mod_tpu.protos import messages as m
 
 
@@ -36,7 +38,10 @@ class PayloadsBuffer:
         self._have: set = set()
         self.next_seq = next_seq
         self._known_to = next_seq          # 1 past the highest num seen
-        self._lock = threading.Lock()
+        # registry-fed: the buffer lock nests inside the provider's
+        # drain lock and around the commit pipe's locks — any future
+        # inversion across those is a detected cycle, not a deadlock
+        self._lock = RegisteredLock("gossip-payloads")
         self.ready = threading.Condition(self._lock)
 
     def push(self, block: m.Block) -> bool:
@@ -113,7 +118,7 @@ class GossipStateProvider:
         self._thread: Optional[threading.Thread] = None
         # serializes pop->commit sequences: two concurrent drain()
         # callers interleaving pops would submit blocks out of order
-        self._drain_lock = threading.Lock()
+        self._drain_lock = RegisteredLock("gossip-state-drain")
         self._active_pipe = None           # the pipe drain last fed
 
     def add_block(self, block: m.Block) -> bool:
@@ -229,7 +234,9 @@ class GossipStateProvider:
                         # check — neither may kill the loop
                         log.warning("anti-entropy tick failed: %s", e)
                     next_tick = time.monotonic() + interval_s
-        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread = RegisteredThread(target=loop,
+                                        name="gossip-state-drain",
+                                        structure="GossipStateProvider")
         self._thread.start()
 
     def stop(self) -> None:
@@ -240,7 +247,8 @@ class GossipStateProvider:
         self._stop.set()
         self.buffer.wake()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            assert_joined((self._thread,),
+                          owner="GossipStateProvider", timeout=5)
         from fabric_mod_tpu.observability import get_logger
         try:
             self.drain()

@@ -1,0 +1,40 @@
+"""Persistent XLA compilation cache, shared by every jitted program.
+
+The ECDSA ladder and the idemix pairing program each cost tens of
+seconds (minutes, on CPU) to compile; pointing jax at a persistent
+on-disk cache makes compiles survive process restarts.  bccsp/tpu.py
+has always enabled this for the verify programs at import; the
+pairing path (ops/fp256bn_dev.py) now does the same at ITS import —
+"service start" for an idemix-verifying peer — so the second
+`bench.py --metric idemix` run (and every production restart) reuses
+the cached executable instead of re-paying the compile
+(VERDICT r5 #8).
+
+FABRIC_MOD_TPU_JIT_CACHE overrides the cache directory.
+"""
+from __future__ import annotations
+
+import os
+
+_enabled = False
+
+
+def enable_compile_cache() -> None:
+    """Idempotent; safe before or after jax initialization, and a
+    silent no-op when jax is unavailable/misconfigured (the caller
+    may be a wheel-less host-only deployment)."""
+    global _enabled
+    if _enabled:
+        return
+    try:
+        import jax
+        cache_dir = os.environ.get(
+            "FABRIC_MOD_TPU_JIT_CACHE",
+            os.path.expanduser("~/.cache/fabric_mod_tpu/jit"))
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _enabled = True
+    except Exception:
+        pass

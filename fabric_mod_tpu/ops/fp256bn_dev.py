@@ -45,6 +45,13 @@ import numpy as np
 
 from fabric_mod_tpu.idemix import fp256bn as host
 from fabric_mod_tpu.ops import limbs9 as limbs
+from fabric_mod_tpu.ops.compilecache import enable_compile_cache
+
+# the pairing program goes on the SAME persistent XLA cache as the
+# ECDSA ladder: importing this module is "service start" for an
+# idemix-verifying peer, and the second process reuses the compiled
+# executable instead of re-paying the multi-minute compile
+enable_compile_cache()
 
 SPEC = limbs.FieldSpec.make("fp256bn.p", host.P)
 _R = 1 << limbs.RBITS

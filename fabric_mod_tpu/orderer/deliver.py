@@ -11,6 +11,7 @@ newest).  The gRPC streaming wrapper rides on top unchanged later.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Iterator, Optional
 
 from fabric_mod_tpu.orderer.registrar import ChainSupport
@@ -41,5 +42,21 @@ class DeliverService:
             with cond:
                 if store.height > num:
                     continue              # raced a write; re-read
-                if not cond.wait(timeout=timeout_s):
-                    return                # idle timeout: end the stream
+                # wait in slices: the writer's cond wakes us on a new
+                # block, but stop_event (the deliver client's stop())
+                # can't notify this cond — an unsliced wait(timeout_s)
+                # would pin a stopped puller (and its commit
+                # pipeline's threads) to the tip for the full idle
+                # timeout (leak found by the FMT_RACECHECK
+                # registered-thread sweep).  0.25 s bounds stop()
+                # latency well inside every join budget without
+                # hammering the writer's condition lock from each
+                # idle stream (commits still wake us instantly)
+                deadline = time.monotonic() + timeout_s
+                while store.height <= num:
+                    if stop_event is not None and stop_event.is_set():
+                        return
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return            # idle timeout: end the stream
+                    cond.wait(timeout=min(0.25, remaining))

@@ -204,6 +204,13 @@ class ChannelParticipation:
         # consensusRelation field)
         if hasattr(chain, "is_leader"):
             info["is_leader"] = bool(chain.is_leader)
+            # which node this consenter BELIEVES leads: a follower
+            # that hasn't learned the leader yet drops forwarded
+            # submits (clients retry by design), so harnesses must be
+            # able to wait for leader knowledge to propagate before
+            # ordering through a follower
+            if hasattr(chain, "leader_id"):
+                info["leader_id"] = chain.leader_id
         return info
 
     # -- join / remove ----------------------------------------------------

@@ -61,7 +61,7 @@ class RaftChain:
                  transport: RaftTransport, wal_path: str, support,
                  election_timeout=(0.15, 0.3), heartbeat_s=0.05,
                  snapshot_interval: Optional[int] = None,
-                 block_fetcher=None):
+                 block_fetcher=None, clock=None, rng=None):
         """`snapshot_interval`: compact the raft log every N applied
         entries (reference: SnapshotIntervalSize).  `block_fetcher`:
         callable(from_height, to_height) -> list[Block] used by a
@@ -70,7 +70,11 @@ class RaftChain:
         orderer/common/cluster/deliver.go:571).  The fetcher runs on
         the raft FSM thread, so it MUST bound its own time (connect +
         read deadlines); raising is safe — the leader re-offers the
-        snapshot with backoff."""
+        snapshot with backoff.  `clock`/`rng` pass through to RaftNode:
+        a utils/fakeclock.ManualClock (plus per-node seeded rngs)
+        makes ELECTION timing fully deterministic for tests — the
+        batch timer below stays wall-clock (cutting a partial batch
+        late is benign; spurious elections are not)."""
         self.node_id = node_id
         self._support = support
         self._transport = transport
@@ -83,9 +87,11 @@ class RaftChain:
             peer_ids = list(cfg_set)
         self._raft = RaftNode(node_id, peer_ids, transport, wal_path,
                               self._apply, election_timeout, heartbeat_s,
+                              rng=rng,
                               snapshot_interval=snapshot_interval,
                               snapshot_cb=self._snapshot_state,
-                              install_cb=self._install_snapshot)
+                              install_cb=self._install_snapshot,
+                              clock=clock)
         if cfg_set and node_id not in cfg_set:
             # configured out (or not yet in): run as observer — apply
             # committed entries, never campaign

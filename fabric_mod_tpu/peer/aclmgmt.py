@@ -47,6 +47,12 @@ class ACLProvider:
     def policy_for(self, resource: str) -> Optional[str]:
         return self._map.get(resource)
 
+    def config_sequence(self) -> Optional[int]:
+        """Current channel config sequence — the invalidation key for
+        session-scoped ACL caches (reference: deliver.go's SessionAC
+        re-evaluates when this advances)."""
+        return getattr(self._bundle(), "sequence", None)
+
     def check_acl(self, resource: str,
                   sds: Sequence[SignedData]) -> None:
         """Raises ACLError unless the signature set satisfies the

@@ -48,11 +48,13 @@ from __future__ import annotations
 
 import functools
 import os
-import queue
 import threading
 import time
 from typing import Callable, List, Optional
 
+from fabric_mod_tpu.concurrency import (GuardedQueue, OwnedState,
+                                        RegisteredLock,
+                                        RegisteredThread, assert_joined)
 from fabric_mod_tpu.observability.metrics import (MetricOpts,
                                                   default_provider)
 
@@ -149,14 +151,23 @@ class PipelinedCommitter:
             depth = pipeline_depth(2)
         self._channel = channel
         self.depth = max(1, depth)
-        self._in_q: "queue.Queue" = queue.Queue(max(1, in_queue))
-        self._staged_q: "queue.Queue" = queue.Queue()
+        # in-queue: many producers (submit callers + close sentinel),
+        # one consumer (the stage loop); staged queue: strict SPSC
+        # stage -> commit.  Ownership is machine-checked under
+        # FMT_RACECHECK.
+        self._in_q: "GuardedQueue" = GuardedQueue(
+            max(1, in_queue), name=f"commitpipe-in[{consumer}]")
+        self._staged_q: "GuardedQueue" = GuardedQueue(
+            name=f"commitpipe-staged[{consumer}]", single_producer=True)
         self._on_commit = on_commit
         self._on_error = on_error
         # one condition variable guards all pipeline state: inflight
         # count (the depth bound), committed height (barrier + flush
-        # waits), the sticky first error
-        self._cv = threading.Condition()
+        # waits), the sticky first error.  Registry-fed lock: the cv
+        # nests inside the submit lock and around the ledger's ranked
+        # OrderedLock — inversions are cycles the registry reports.
+        self._cv = threading.Condition(
+            RegisteredLock(f"commitpipe-cv[{consumer}]"))
         self._inflight = 0
         self._height = channel.ledger.height
         self._barrier_height: Optional[int] = None
@@ -164,20 +175,40 @@ class PipelinedCommitter:
         self._err: Optional[Exception] = None
         self._closed = False
         self._started = False
-        self._start_lock = threading.Lock()
+        self._start_lock = RegisteredLock(
+            f"commitpipe-start[{consumer}]")
         # serializes producers through the in-queue put: without it,
         # two overlapping store_block callers could update
         # _last_submitted in order yet enqueue out of order
-        self._submit_lock = threading.Lock()
+        self._submit_lock = RegisteredLock(
+            f"commitpipe-submit[{consumer}]")
         self._threads: List[threading.Thread] = []
         # cumulative per-stage wall seconds (the e2e bench reads these
-        # off the deliver client to show the verify/commit overlap)
-        self.stage_secs = 0.0
-        self.await_secs = 0.0
-        self.commit_secs = 0.0
+        # off the deliver client to show the verify/commit overlap).
+        # Single-writer contract made machine-checked: the stage loop
+        # owns stage timing, the commit loop owns await/commit timing;
+        # reads (bench, deliver client) stay open.
+        self._stage_state = OwnedState(
+            f"commitpipe-stage[{consumer}]", secs=0.0)
+        self._commit_state = OwnedState(
+            f"commitpipe-commit[{consumer}]", await_secs=0.0,
+            commit_secs=0.0)
         (self._m_stage, self._m_await, self._m_commit,
          occupancy, self._m_barriers, self._m_blocks) = _metrics()
         self._m_occupancy = occupancy.with_labels(consumer)
+
+    # -- timing surface (kept: bench/deliver-client read these) -----------
+    @property
+    def stage_secs(self) -> float:
+        return self._stage_state.secs
+
+    @property
+    def await_secs(self) -> float:
+        return self._commit_state.await_secs
+
+    @property
+    def commit_secs(self) -> float:
+        return self._commit_state.commit_secs
 
     # -- lifecycle -------------------------------------------------------
     def _ensure_started(self) -> None:
@@ -187,7 +218,8 @@ class PipelinedCommitter:
             self._started = True
             for name, fn in (("commitpipe-stage", self._stage_loop),
                              ("commitpipe-commit", self._commit_loop)):
-                t = threading.Thread(target=fn, name=name, daemon=True)
+                t = RegisteredThread(target=fn, name=name,
+                                     structure="PipelinedCommitter")
                 t.start()
                 self._threads.append(t)
 
@@ -304,8 +336,12 @@ class PipelinedCommitter:
         if not started:
             return
         self._in_q.put(None)
-        for t in self._threads:
-            t.join(timeout=timeout_s)
+        # leak-checked join: with FMT_RACECHECK armed, workers that
+        # outlive the drain raise instead of parking as daemons.  The
+        # commit loop may legally call close() via on_error/on_commit
+        # callbacks — assert_joined skips the current thread.
+        assert_joined(self._threads, owner="PipelinedCommitter",
+                      timeout=timeout_s)
 
     @property
     def closed(self) -> bool:
@@ -334,7 +370,7 @@ class PipelinedCommitter:
                 t0 = time.perf_counter()
                 staged = self._channel.stage_block(block)
                 dt = time.perf_counter() - t0
-                self.stage_secs += dt
+                self._stage_state.secs += dt
                 self._m_stage.observe(dt)
                 if staged.needs_barrier:
                     with self._cv:
@@ -359,12 +395,12 @@ class PipelinedCommitter:
                 t0 = time.perf_counter()
                 staged.resolve_mask()      # the device-verdict wait
                 dt = time.perf_counter() - t0
-                self.await_secs += dt
+                self._commit_state.await_secs += dt
                 self._m_await.observe(dt)
                 t0 = time.perf_counter()
                 flags = self._channel.commit_staged(staged)
                 dt = time.perf_counter() - t0
-                self.commit_secs += dt
+                self._commit_state.commit_secs += dt
                 self._m_commit.observe(dt)
             except Exception as e:
                 self._fail(e)

@@ -33,6 +33,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, List, Optional
 
+from fabric_mod_tpu.concurrency import OwnedState
 from fabric_mod_tpu.peer.channel import Channel
 from fabric_mod_tpu.peer.commitpipe import PipelinedCommitter, pipeline_depth
 from fabric_mod_tpu.peer.mcs import BlockVerificationError
@@ -72,6 +73,11 @@ class DeliverClient:
         self._secs_base = [0.0, 0.0, 0.0]  # stage, await, commit
         self._pipe = self._make_pipe()
         self.rejected: List[int] = []      # block numbers that failed MCS
+        # stage-1 exclusivity: run() claims this state for its thread;
+        # a SECOND concurrent run() on one client would double-pull
+        # and double-submit — under FMT_RACECHECK the second claim
+        # raises instead (sequential re-runs re-claim freely)
+        self._runner = OwnedState("deliverclient-runner")
 
     def _make_pipe(self) -> PipelinedCommitter:
         def fail(e: Exception) -> None:
@@ -116,7 +122,22 @@ class DeliverClient:
             idle_timeout_s: float = 30.0) -> None:
         """Pull from the ledger's current height until `stop_at` (block
         number, inclusive) or the source goes idle.  Blocking; callers
-        wanting a background client wrap this in a thread."""
+        wanting a background client wrap this in a thread.  One run()
+        at a time: a concurrent second run() is a race (double pull,
+        interleaved submits) and is rejected under FMT_RACECHECK."""
+        self._runner.claim()
+        try:
+            self._run_claimed(stop_at, idle_timeout_s)
+        finally:
+            # released on EVERY exit (including a raise before or
+            # inside the pull loop, or from pipe.close) — a leaked
+            # claim would turn every later run() into a false race
+            self._runner.release()
+        if self._pipe.error is not None:
+            raise self._pipe.error
+
+    def _run_claimed(self, stop_at: Optional[int],
+                     idle_timeout_s: float) -> None:
         if self._pipe.closed:
             # reusable client (the pre-engine contract): each run()
             # gets fresh workers; prior runs' timings accumulate
@@ -167,8 +188,6 @@ class DeliverClient:
             # returns with commits silently in flight, however long
             # the tail block's cold XLA compile takes
             self._pipe.close()
-        if self._pipe.error is not None:
-            raise self._pipe.error
 
     def stop(self) -> None:
         self._stop.set()

@@ -20,7 +20,7 @@ its validation code — which `chaincode invoke --wait-event` uses.
 from __future__ import annotations
 
 import threading
-from typing import Iterator, Optional, Tuple
+from typing import Callable, Iterator, Optional, Tuple
 
 from fabric_mod_tpu.comm.grpc_comm import GRPCClient, GRPCServer, MethodKind
 from fabric_mod_tpu.protos import messages as m
@@ -61,6 +61,18 @@ def filtered_block(channel_id: str, block: m.Block) -> m.FilteredBlock:
     return m.FilteredBlock(channel_id=channel_id,
                            number=block.header.number,
                            filtered_transactions=ftxs)
+
+
+def _is_config_block(block: m.Block) -> bool:
+    """Whether a committed block carries a channel config transaction
+    (first envelope's header type; config blocks hold exactly one)."""
+    try:
+        env = protoutil.get_envelopes(block)[0]
+        payload = protoutil.unmarshal_envelope_payload(env)
+        ch = m.ChannelHeader.decode(payload.header.channel_header)
+        return ch.type == m.HeaderType.CONFIG
+    except Exception:
+        return False
 
 
 def _filtered_actions(tx_bytes: bytes) -> m.FilteredTransactionActions:
@@ -113,6 +125,12 @@ class EventDeliverServer:
         # starve ProcessProposal (the reference bounds this with its
         # grpc server's stream limits + deliver handler accounting)
         self._streams = threading.Semaphore(max_streams)
+        # committed blocks are immutable, so their config/not-config
+        # classification is too: memoized by block number so N
+        # subscribers don't each re-decode every block's first
+        # envelope on the event hot path (GIL-atomic dict ops; a
+        # racing duplicate compute is harmless)
+        self._cfg_memo: dict = {}
         self._owns_grpc = grpc is None
         self._grpc = grpc or GRPCServer(address, server_cert_pem,
                                         server_key_pem, client_root_pem)
@@ -138,6 +156,18 @@ class EventDeliverServer:
 
     # -- stream handler --------------------------------------------------
 
+    def _block_is_config(self, blk: m.Block) -> bool:
+        # local-read/return: a concurrent stream's clear() between our
+        # store and a re-read must not KeyError a live subscription
+        num = blk.header.number
+        val = self._cfg_memo.get(num)
+        if val is None:
+            val = _is_config_block(blk)
+            if len(self._cfg_memo) > 4096:
+                self._cfg_memo.clear()
+            self._cfg_memo[num] = val
+        return val
+
     def _make_handler(self, filtered: bool):
         def handle(request_iter, context) -> Iterator[bytes]:
             if not self._streams.acquire(blocking=False):
@@ -146,14 +176,16 @@ class EventDeliverServer:
                 return
             try:
                 for raw in request_iter:
-                    status, seek = self._check_request(raw, filtered)
+                    status, seek, recheck = self._check_request(
+                        raw, filtered)
                     if seek is None:
                         yield m.DeliverResponse(status=status).encode()
                         return
                     stop_event = threading.Event()
                     context.add_callback(stop_event.set)
                     final = {"status": m.Status.SUCCESS}
-                    for blk in self._blocks(seek, stop_event, final):
+                    for blk in self._blocks(seek, stop_event, final,
+                                            recheck):
                         if filtered:
                             resp = m.DeliverResponse(
                                 filtered_block=filtered_block(
@@ -168,7 +200,8 @@ class EventDeliverServer:
         return handle
 
     def _check_request(self, raw: bytes, filtered: bool
-                       ) -> Tuple[int, Optional[m.SeekInfo]]:
+                       ) -> Tuple[int, Optional[m.SeekInfo],
+                                  Optional[Callable[[], None]]]:
         try:
             env = m.Envelope.decode(raw)
             payload = protoutil.unmarshal_envelope_payload(env)
@@ -176,34 +209,68 @@ class EventDeliverServer:
             sh = m.SignatureHeader.decode(payload.header.signature_header)
             seek = m.SeekInfo.decode(payload.data)
         except Exception:
-            return m.Status.BAD_REQUEST, None
+            return m.Status.BAD_REQUEST, None, None
         # Only DELIVER_SEEK_INFO envelopes are seek requests: any other
         # well-signed envelope type decoding "successfully" as SeekInfo
         # is an accident of the wire format, not a request (the
         # reference's deliver handler validates the header type before
         # the payload — deliver/deliver.go).
         if ch.type != m.HeaderType.DELIVER_SEEK_INFO:
-            return m.Status.BAD_REQUEST, None
+            return m.Status.BAD_REQUEST, None, None
         if ch.channel_id != self._channel_id:
-            return m.Status.NOT_FOUND, None
+            return m.Status.NOT_FOUND, None, None
         resource = "event/FilteredBlock" if filtered else "event/Block"
         sd = SignedData(data=env.payload, identity=sh.creator,
                         signature=env.signature)
+        # snapshot the config sequence BEFORE the initial ACL check:
+        # a config update committing between the check and the
+        # snapshot would otherwise record the NEW sequence against a
+        # verdict computed under the OLD config, and the session
+        # re-check below would never fire for it
+        seq_of = getattr(self._acl, "config_sequence", None)
+        state = {"seq": seq_of() if seq_of is not None else None}
         try:
             self._acl.check_acl(resource, [sd])
         except Exception:
-            return m.Status.FORBIDDEN, None
-        return m.Status.SUCCESS, seek
+            return m.Status.FORBIDDEN, None, None
+        # the session re-check: the ACL provider reads the CURRENT
+        # channel bundle, so re-running this closure after a config
+        # block commits evaluates the NEW config (reference:
+        # common/deliver/deliver.go:157-199 — SessionAC re-evaluates
+        # when the config sequence advances).  Cached by sequence: a
+        # full check re-verifies the seek signature against channel
+        # policy, too expensive per block — so the closure is a no-op
+        # until the sequence moves (or `force`, for a config block
+        # flowing through THIS stream, which revokes even when the
+        # bundle swap isn't visible as a sequence change).
+
+        def recheck(force: bool = False) -> None:
+            seq = seq_of() if seq_of is not None else None
+            if force or seq != state["seq"]:
+                state["seq"] = seq
+                self._acl.check_acl(resource, [sd])
+        return m.Status.SUCCESS, seek, recheck
 
     def _blocks(self, seek: m.SeekInfo, stop_event: threading.Event,
-                final: dict) -> Iterator[m.Block]:
+                final: dict, recheck=None) -> Iterator[m.Block]:
         """BLOCK_UNTIL_READY streams wait at the tip indefinitely —
         the client's gRPC deadline/cancel (via `stop_event`) and
         server close (`_closing`) are the only terminators, so long
         event subscriptions are not silently capped (reference:
         deliver.go's commit-notified wait).  FAIL_IF_NOT_READY at a
         missing block sets final["status"]=NOT_FOUND — the retryable
-        error, not an empty success."""
+        error, not an empty success.
+
+        `recheck` re-evaluates the stream's ACL against the CURRENT
+        channel config before every block send — forced when a config
+        block flows through THIS stream, and whenever the channel's
+        config sequence has advanced (so a bounded or lagging stream
+        that never reaches the config block is still cut off the
+        moment the revoking config commits): a revoked subscriber
+        gets FORBIDDEN before the next block — fail-closed; a
+        standing BLOCK_UNTIL_READY subscription is not a grandfather
+        clause (reference: deliver.go:157-199's session-ACL
+        re-evaluation on config sequence change)."""
         led = self._ledger
         h = led.height
         num = protoutil.seek_number(seek.start, h, newest_tip=True) or 0
@@ -214,6 +281,12 @@ class EventDeliverServer:
                 return
             blk = led.get_block_by_number(num)
             if blk is not None:
+                if recheck is not None:
+                    try:
+                        recheck(force=self._block_is_config(blk))
+                    except Exception:
+                        final["status"] = m.Status.FORBIDDEN
+                        return
                 yield blk
                 num += 1
                 continue

@@ -1,107 +1,14 @@
-"""Race-detection analog: lock-order + thread-ownership checking.
+"""Race-detection primitives — compatibility shim.
 
-(reference: scripts/run-unit-tests.sh:142-161 runs the whole unit
-suite under the Go race detector.  Python has no -race; what bites
-in this codebase's threaded core are (a) lock-order inversions
-(deadlocks) and (b) structures owned by one thread being mutated from
-another.  This module makes both crash loudly instead of corrupting
-silently: OrderedLock enforces a global lock hierarchy per thread,
-ThreadOwnership pins a structure to its owning thread.  Both are
-cheap enough to stay ON in production paths; the seeded interleaving
-stress tier (tests/test_racecheck.py) drives them hard and proves via
-injected-race canaries that they actually bite.)
+The detectors grew into the full concurrency-correctness subsystem at
+``fabric_mod_tpu/concurrency/`` (guarded queues, field-level
+ownership, registered threads, and the process-wide lock-order
+registry with cycle detection, all armed suite-wide by
+``FMT_RACECHECK=1``).  This module keeps the original import surface
+for the ledger/raft call sites and external users; new code should
+import from ``fabric_mod_tpu.concurrency`` directly.
 """
-from __future__ import annotations
+from fabric_mod_tpu.concurrency import (OrderedLock, RaceError,
+                                        ThreadOwnership)
 
-import threading
-from typing import Optional
-
-
-class RaceError(AssertionError):
-    """A detected race/ordering violation (AssertionError so test
-    frameworks treat it as a hard failure, never a skip)."""
-
-
-_tls = threading.local()
-
-
-def _held():
-    h = getattr(_tls, "held", None)
-    if h is None:
-        h = _tls.held = []
-    return h
-
-
-class OrderedLock:
-    """An RLock with a rank in a global hierarchy: a thread may only
-    acquire ranks STRICTLY ABOVE the highest it already holds (re-
-    entry on the same lock is fine).  Any inversion — the classic
-    AB/BA deadlock shape — raises RaceError at acquire time, on the
-    first interleaving that exhibits it, instead of deadlocking one
-    run in a thousand."""
-
-    def __init__(self, rank: int, name: str = ""):
-        self.rank = rank
-        self.name = name or f"lock@{rank}"
-        self._lock = threading.RLock()
-
-    def acquire(self, blocking: bool = True, timeout: float = -1):
-        held = _held()
-        # Re-entry of ANY already-held lock is always safe (RLock) and
-        # exempt from the rank rule — scan the whole held stack, not
-        # just its top: ledger(10) -> pvtstore(30) -> ledger(10) again
-        # cannot deadlock, and the checker runs live on production
-        # commit paths where a false positive would abort commits.
-        # Fresh locks still check against the HIGHEST held rank (not
-        # the stack top — after a re-entry the top can be a low rank
-        # that would mask a real inversion against a lock in between).
-        if held and not any(h[1] is self for h in held):
-            top_rank, top_lock = max(held, key=lambda h: h[0])
-            if top_rank >= self.rank:
-                raise RaceError(
-                    f"lock-order violation: acquiring {self.name} "
-                    f"(rank {self.rank}) while holding "
-                    f"{top_lock.name} (rank {top_rank}) — the "
-                    f"hierarchy requires strictly increasing ranks")
-        ok = self._lock.acquire(blocking, timeout)
-        if ok:
-            held.append((self.rank, self))
-        return ok
-
-    def release(self):
-        held = _held()
-        for i in range(len(held) - 1, -1, -1):
-            if held[i][1] is self:
-                del held[i]
-                break
-        self._lock.release()
-
-    __enter__ = acquire
-
-    def __exit__(self, *exc):
-        self.release()
-
-
-class ThreadOwnership:
-    """Pins a structure to one owning thread.  `claim()` binds the
-    current thread (the FSM/worker thread at startup); `guard()`
-    raises when any OTHER thread enters a guarded section.  The
-    raft FSM's whole design contract — all state transitions on the
-    FSM thread (chain.go:533's single-threaded run loop) — becomes
-    machine-checked instead of a docstring."""
-
-    def __init__(self, name: str = "structure"):
-        self.name = name
-        self._owner: Optional[int] = None
-
-    def claim(self) -> None:
-        self._owner = threading.get_ident()
-
-    def guard(self) -> None:
-        if self._owner is None:
-            return                        # not yet claimed (startup)
-        me = threading.get_ident()
-        if me != self._owner:
-            raise RaceError(
-                f"thread-ownership violation: {self.name} touched "
-                f"from thread {me}, owned by {self._owner}")
+__all__ = ["OrderedLock", "RaceError", "ThreadOwnership"]

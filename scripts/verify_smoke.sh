@@ -1,5 +1,8 @@
 #!/usr/bin/env bash
 # CPU smoke target for the verify + commit pipeline:
+#   0. the FMT_RACECHECK=1 canary slice (concurrency guards armed
+#      over every retrofitted threaded structure) + the
+#      deterministic-clock raft elections
 #   1. the mixed-ladder verdict differential (incl. the fused-hash
 #      raw-vs-digest check)
 #   2. the fused hash->verify A/B
@@ -17,6 +20,16 @@
 # identity assertion fails (bench.py propagates per-metric rc).
 set -euo pipefail
 cd "$(dirname "$0")/.."
+# 0. the race tier's canary slice under FMT_RACECHECK=1: every guard
+#    of fabric_mod_tpu/concurrency armed over the retrofitted
+#    structures (gossip comm senders, the verify-service flusher, the
+#    commit pipeline, deliverclient, election, the gossip drain) plus
+#    the deterministic-clock raft election suite — cheap (<1 min) and
+#    run on EVERY change, so a reintroduced race or lock inversion
+#    fails the smoke before it ever flakes in CI
+FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
+    -p no:cacheprovider -p no:randomly \
+    tests/test_racecheck.py tests/test_raft_fakeclock.py
 # CPU XLA compiles of the verify cores run multiple minutes each (the
 # persistent compile cache is TPU-oriented); give the worker room.
 export FABRIC_MOD_TPU_BENCH_TIMEOUT="${FABRIC_MOD_TPU_BENCH_TIMEOUT:-2400}"

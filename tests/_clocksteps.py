@@ -1,0 +1,45 @@
+"""Shared fake-clock stepping for the deterministic raft tiers.
+
+One implementation for test_raft.py / test_cluster.py /
+test_raft_fakeclock.py (each wraps it with its own step sizes): step
+fake time finely so the EARLIEST pending timer fires alone — a coarse
+jump would expire every node's timeout in one wave and split the vote;
+randomized timeouts only help when time moves continuously.  Between
+steps, real-time-settle the FSM threads: message passing is still
+thread-based, only TIMERS are faked.
+"""
+import time
+
+
+def settle(pred, timeout=5.0, poll=0.005):
+    """Wait (REAL time) for the FSM threads to process queued work."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    return pred()
+
+
+def advance_until(clock, pred, step=0.02, max_steps=150,
+                  settle_timeout=0.2, settle_poll=0.005,
+                  final_timeout=5.0):
+    for _ in range(max_steps):
+        if settle(pred, timeout=settle_timeout, poll=settle_poll):
+            return True
+        clock.advance(step)
+    return settle(pred, timeout=final_timeout)
+
+
+def leader_known_by_all(chains):
+    """True once exactly ONE chain leads and EVERY chain's raft layer
+    has learned that leader's id.  Ordering through a follower before
+    this point is legitimately lossy: a leaderless follower DROPS
+    forwarded submits (clients retry, by design), so election waits
+    that gate a follower-side `order()` must use this predicate, not
+    `any(is_leader)` — under suite load the unknown-leader window
+    otherwise widens into a dropped-batch flake."""
+    leaders = [i for i, c in chains.items() if c.is_leader]
+    if len(leaders) != 1:
+        return False
+    return all(c.leader_id == leaders[0] for c in chains.values())

@@ -24,6 +24,28 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# FMT_RACECHECK=1 arms every guard in fabric_mod_tpu/concurrency for
+# the whole run (the package reads the env var at import): guarded
+# queues, field/thread ownership, the lock-order registry, and
+# leak-checked teardowns all raise RaceError instead of racing.  This
+# is the suite-wide race tier — the analog of the reference running
+# its whole unit suite under `go test -race`
+# (scripts/run-unit-tests.sh:142-161).
+RACECHECK = os.environ.get("FMT_RACECHECK", "") not in ("", "0")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not RACECHECK:
+        return
+    from fabric_mod_tpu.concurrency import live_registered
+    leaked = live_registered()
+    if leaked:
+        # advisory sweep: per-structure close() paths already hard-fail
+        # on their own workers; this catches structures never closed
+        names = sorted({f"{t.structure}:{t.name}" for t in leaked})
+        print(f"\n[FMT_RACECHECK] {len(leaked)} registered thread(s) "
+              f"still alive at session end: {', '.join(names[:20])}")
+
 
 @pytest.fixture(scope="session")
 def rng():

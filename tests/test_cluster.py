@@ -5,10 +5,20 @@ leader kill, and refusing unauthenticated dialers.
 (reference test model: orderer/common/cluster suites + the raft
 integration tests — consensus messages over the Step RPC with
 TLS-pinned membership.)
+
+Election timing: the leader-kill re-election (the load-flaky
+assertion) runs on utils/fakeclock.ManualClock — explicit advances
+drive the timers, real time only settles gRPC message delivery.  The
+identical-chains test stays WALL-CLOCK as this suite's real-time
+smoke: the production time source must keep electing over the real
+transport.
 """
+import random
 import time
 
 import pytest
+
+from tests._clocksteps import advance_until, leader_known_by_all
 
 from fabric_mod_tpu.bccsp.sw import SwCSP
 from fabric_mod_tpu.channelconfig import genesis
@@ -22,6 +32,7 @@ from fabric_mod_tpu.orderer.raft import AppendEntries, RequestVote
 from fabric_mod_tpu.orderer.raftchain import RaftChain
 from fabric_mod_tpu.orderer.registrar import Registrar
 from fabric_mod_tpu.protos import protoutil
+from fabric_mod_tpu.utils.fakeclock import ManualClock
 
 
 def _wait(pred, t=20.0):
@@ -31,6 +42,12 @@ def _wait(pred, t=20.0):
             return True
         time.sleep(0.05)
     return False
+
+
+def _advance_until(clock, pred, step=0.05, max_steps=150):
+    # coarser settles than test_raft's: gRPC delivery between steps
+    return advance_until(clock, pred, step=step, max_steps=max_steps,
+                         settle_timeout=0.25, settle_poll=0.05)
 
 
 def test_message_codec_roundtrip():
@@ -47,59 +64,77 @@ def test_message_codec_roundtrip():
 
 
 @pytest.fixture()
-def cluster(tmp_path):
-    tls = TlsCA()
-    csp = SwCSP()
-    org_ca = calib.CA("ca.org1", "Org1")
-    ord_ca = calib.CA("ca.o", "OrdererOrg")
-    blk = genesis.standard_network(
-        "gchan", {"Org1": [calib.cert_pem(org_ca.cert)]},
-        {"OrdererOrg": [calib.cert_pem(ord_ca.cert)]},
-        consensus_type="etcdraft", batch_timeout="200ms",
-        max_message_count=3)
-    ids = ["g0", "g1", "g2"]
-    transports = {}
-    for i in ids:
-        scert, skey = tls.issue(f"{i}.cluster",
-                                sans=("localhost", "127.0.0.1"))
-        ccert, ckey = tls.issue(f"{i}.client")
-        transports[i] = GRPCRaftTransport(
-            i, {j: "127.0.0.1:0" for j in ids},
-            listen_address="127.0.0.1:0",
-            server_cert=scert, server_key=skey,
-            client_ca=tls.cert_pem,
-            client_cert=ccert, client_key=ckey)
-    # exchange real ports, then serve
-    for i in ids:
-        for j in ids:
-            transports[i].set_peer_address(
-                j, f"127.0.0.1:{transports[j].listen_port}")
-        transports[i].start()
-    registrars = {}
-    for i in ids:
-        oc, ok = ord_ca.issue(f"{i}.o", "OrdererOrg", ous=["orderer"])
-        signer = SigningIdentity("OrdererOrg", oc, calib.key_pem(ok),
-                                 csp)
+def make_cluster(tmp_path):
+    """Factory: build the 3-orderer gRPC cluster, wall-clock
+    (clock=None — the real-time smoke) or on a shared ManualClock."""
+    worlds = []
 
-        def factory(support, i=i):
-            return RaftChain(i, ids, transports[i],
-                             str(tmp_path / f"{i}.wal"), support,
-                             election_timeout=(0.3, 0.6),
-                             heartbeat_s=0.1)
-        reg = Registrar(str(tmp_path / i), signer, csp,
-                        chain_factory=factory)
-        reg.create_channel(blk)
-        registrars[i] = reg
-    world = {"ids": ids, "transports": transports,
-             "registrars": registrars, "csp": csp, "org_ca": org_ca,
-             "tls": tls,
-             "supports": {i: registrars[i].get_chain("gchan")
-                          for i in ids}}
-    yield world
-    for reg in registrars.values():
-        reg.close()
-    for tr in transports.values():
-        tr.stop()
+    def make(clock=None):
+        tls = TlsCA()
+        csp = SwCSP()
+        org_ca = calib.CA("ca.org1", "Org1")
+        ord_ca = calib.CA("ca.o", "OrdererOrg")
+        blk = genesis.standard_network(
+            "gchan", {"Org1": [calib.cert_pem(org_ca.cert)]},
+            {"OrdererOrg": [calib.cert_pem(ord_ca.cert)]},
+            consensus_type="etcdraft", batch_timeout="200ms",
+            max_message_count=3)
+        ids = ["g0", "g1", "g2"]
+        transports = {}
+        for i in ids:
+            scert, skey = tls.issue(f"{i}.cluster",
+                                    sans=("localhost", "127.0.0.1"))
+            ccert, ckey = tls.issue(f"{i}.client")
+            transports[i] = GRPCRaftTransport(
+                i, {j: "127.0.0.1:0" for j in ids},
+                listen_address="127.0.0.1:0",
+                server_cert=scert, server_key=skey,
+                client_ca=tls.cert_pem,
+                client_cert=ccert, client_key=ckey)
+        # exchange real ports, then serve
+        for i in ids:
+            for j in ids:
+                transports[i].set_peer_address(
+                    j, f"127.0.0.1:{transports[j].listen_port}")
+            transports[i].start()
+        registrars = {}
+        for idx, i in enumerate(ids):
+            oc, ok = ord_ca.issue(f"{i}.o", "OrdererOrg",
+                                  ous=["orderer"])
+            signer = SigningIdentity("OrdererOrg", oc,
+                                     calib.key_pem(ok), csp)
+
+            def factory(support, i=i, idx=idx):
+                return RaftChain(
+                    i, ids, transports[i],
+                    str(tmp_path / f"{i}.wal"), support,
+                    election_timeout=(0.3, 0.6), heartbeat_s=0.1,
+                    clock=clock,
+                    rng=random.Random(idx + 1) if clock else None)
+            reg = Registrar(str(tmp_path / i), signer, csp,
+                            chain_factory=factory)
+            reg.create_channel(blk)
+            registrars[i] = reg
+        world = {"ids": ids, "transports": transports,
+                 "registrars": registrars, "csp": csp,
+                 "org_ca": org_ca, "tls": tls, "clock": clock,
+                 "supports": {i: registrars[i].get_chain("gchan")
+                              for i in ids}}
+        worlds.append(world)
+        return world
+
+    yield make
+    for world in worlds:
+        for reg in world["registrars"].values():
+            reg.close()
+        for tr in world["transports"].values():
+            tr.stop()
+
+
+@pytest.fixture()
+def cluster(make_cluster):
+    """Wall-clock cluster (the real-time smoke path)."""
+    return make_cluster(None)
 
 
 def _env(world, k):
@@ -116,10 +151,13 @@ def _env(world, k):
 
 
 def test_raft_over_grpc_orders_identical_chains(cluster):
+    """REAL-time smoke (wall-clock timers over the real transport —
+    the one election in this suite that keeps exercising the
+    production time source)."""
     world = cluster
     sup = world["supports"]
     chains = {i: s.chain for i, s in sup.items()}
-    assert _wait(lambda: any(c.is_leader for c in chains.values()),
+    assert _wait(lambda: leader_known_by_all(chains),
                  t=30.0), "no leader over gRPC"
     follower = next(i for i, c in chains.items() if not c.is_leader)
     for k in range(8):                    # submit via a FOLLOWER
@@ -137,12 +175,17 @@ def test_raft_over_grpc_orders_identical_chains(cluster):
         assert len(hashes) == 1, f"divergence at {n}"
 
 
-def test_raft_over_grpc_survives_leader_kill(cluster):
-    world = cluster
+def test_raft_over_grpc_survives_leader_kill(make_cluster):
+    """The load-flaky re-election assertion, now deterministic: the
+    shared ManualClock is the only thing that can expire election
+    timers, so a survivor campaigns exactly when the test advances —
+    never early under CPU starvation, never missed."""
+    world = make_cluster(ManualClock())
+    clock = world["clock"]
     sup = world["supports"]
     chains = {i: s.chain for i, s in sup.items()}
-    assert _wait(lambda: any(c.is_leader for c in chains.values()),
-                 t=30.0)
+    assert _advance_until(clock, lambda: any(c.is_leader
+                                             for c in chains.values()))
     leader_id = next(i for i, c in chains.items() if c.is_leader)
     for k in range(3):
         sup[leader_id].chain.order(_env(world, k), 0)
@@ -154,8 +197,9 @@ def test_raft_over_grpc_survives_leader_kill(cluster):
     world["transports"][leader_id].stop()
     world["registrars"][leader_id].close()
     rest = {i: c for i, c in chains.items() if i != leader_id}
-    assert _wait(lambda: any(c.is_leader for c in rest.values()),
-                 t=40.0), "no re-election after leader kill"
+    assert _advance_until(clock, lambda: any(c.is_leader
+                                             for c in rest.values())), \
+        "no re-election after leader kill"
     survivor = next(i for i, c in rest.items() if c.is_leader)
     for k in range(3, 6):
         sup[survivor].chain.order(_env(world, k), 0)

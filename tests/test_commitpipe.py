@@ -412,6 +412,7 @@ def test_gossip_drain_through_pipeline(world, simple4, tmp_path):
     for i in range(len(simple4)):
         blk = chan.ledger.get_block_by_number(i)
         assert list(protoutil.block_txflags(blk)) == [V.VALID]
+    chan.commit_pipeline().close()
 
 
 def test_channel_store_block_routes_through_knob(world, tmp_path,

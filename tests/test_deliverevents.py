@@ -73,12 +73,14 @@ def test_non_seek_envelope_rejected_bad_request(world):
                                          protoutil.new_nonce())
     payload = protoutil.make_payload(ch, sh, seek.encode())
     env = protoutil.sign_envelope(payload, net.client)
-    status, got = server._check_request(env.encode(), filtered=True)
+    status, got, _ = server._check_request(env.encode(), filtered=True)
     assert status == m.Status.BAD_REQUEST and got is None
     # control: the correctly-typed envelope still passes
     good = make_signed_seek_envelope(net.channel_id, 0, 0, net.client)
-    status, got = server._check_request(good.encode(), filtered=True)
+    status, got, recheck = server._check_request(good.encode(),
+                                                 filtered=True)
     assert status == m.Status.SUCCESS and got is not None
+    recheck()                              # session re-check callable
 
 
 def test_wait_for_tx_learns_code_across_commit(world):
@@ -176,3 +178,100 @@ def test_filtered_block_projection_unit():
     fb = filtered_block("ch", blk)
     assert fb.number == 7
     assert fb.filtered_transactions[0].tx_validation_code == V.BAD_PAYLOAD
+
+
+# --- mid-stream ACL re-evaluation at config blocks -------------------------
+
+class _RevocableAcl:
+    """Real ACLProvider behavior until `revoked` flips — the stand-in
+    for a config update whose new MSP/CRL rejects the subscriber (the
+    bundle-backed provider re-reads the CURRENT config on every
+    check, so the flip models exactly what a committed revocation
+    changes)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.revoked = False
+        self.checks = 0
+
+    def check_acl(self, resource, sds):
+        self.checks += 1
+        if self.revoked:
+            raise PermissionError("identity revoked by channel config")
+        return self._inner.check_acl(resource, sds)
+
+
+def _commit_config_block(net):
+    """Append a genuine CONFIG-type block to the peer ledger (the
+    config machinery upstream swaps the bundle; the ledger commit is
+    what the event stream observes)."""
+    ch = protoutil.make_channel_header(
+        m.HeaderType.CONFIG, net.channel_id, tx_id="cfg-revoke")
+    sh = protoutil.make_signature_header(net.client.serialize(),
+                                         protoutil.new_nonce())
+    payload = protoutil.make_payload(ch, sh, b"new-config-bytes")
+    env = protoutil.sign_envelope(payload, net.client)
+    h = net.ledger.height
+    prev = protoutil.block_header_hash(
+        net.ledger.get_block_by_number(h - 1).header)
+    blk = protoutil.new_block(h, prev, [env])
+    net.ledger.commit_block(blk, [V.VALID])
+    return h
+
+
+def test_revoked_subscriber_cut_off_at_config_block(tmp_path):
+    """A revoked identity holding a BLOCK_UNTIL_READY subscription is
+    terminated with FORBIDDEN when the config block commits — it
+    receives neither the config block nor anything after it
+    (reference: common/deliver/deliver.go:157-199)."""
+    from fabric_mod_tpu.e2e import Network
+    from fabric_mod_tpu.peer.aclmgmt import ACLProvider
+
+    net = Network(str(tmp_path), batch_timeout="100ms",
+                  max_message_count=25)
+    acl = _RevocableAcl(ACLProvider(net.channel.bundle))
+    server = EventDeliverServer(net.channel_id, net.ledger, acl)
+    server.start()
+    grpc_client = GRPCClient(f"127.0.0.1:{server.port}")
+    try:
+        net.invoke([b"put", b"k0", b"v0"])
+        net.pump_committed(1)
+        evc = _events_client(net, grpc_client)
+
+        got, outcome = [], {}
+
+        def subscribe():
+            try:
+                for fb in evc.filtered_blocks(start=0, stop=None,
+                                              timeout_s=30):
+                    got.append(fb.number)
+            except EventStreamError as e:
+                outcome["status"] = e.status
+
+        t = threading.Thread(target=subscribe, daemon=True)
+        t.start()
+        # the subscriber reaches the tip and parks there
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                len(got) < net.ledger.height:
+            time.sleep(0.02)
+        assert len(got) == net.ledger.height
+        # the revoking config commits
+        acl.revoked = True
+        cfg_num = _commit_config_block(net)
+        t.join(timeout=15)
+        assert not t.is_alive(), "revoked stream did not terminate"
+        assert outcome.get("status") == m.Status.FORBIDDEN
+        assert cfg_num not in got, \
+            "revoked subscriber received the config block"
+        # a still-authorized subscriber DOES get the config block and
+        # keeps streaming (the re-check only bites revoked sessions)
+        acl.revoked = False
+        nums = [fb.number for fb in
+                evc.filtered_blocks(start=0,
+                                    stop=net.ledger.height - 1)]
+        assert cfg_num in nums
+    finally:
+        grpc_client.close()
+        server.stop()
+        net.close()

@@ -52,7 +52,12 @@ def _commit_through(net, n_txs, stop_at=None, timeout=90.0):
             break
         time.sleep(0.02)
     client.stop()
-    t.join(timeout=5)
+    # run() drains + closes its commit pipeline before returning; the
+    # sliced tip-wait in DeliverService.blocks makes stop() prompt,
+    # but in-flight commits on the pure-python EC fallback can take
+    # seconds — give the join real headroom so no pipeline threads
+    # outlive the test (the FMT_RACECHECK sweep flags survivors)
+    t.join(timeout=30)
     return committed, client
 
 

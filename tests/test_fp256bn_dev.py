@@ -2,12 +2,22 @@
 
 (reference test model: differential testing against the pinned host
 semantics of idemix/fp256bn.py, which themselves anchor to
-idemix/signature.go:243 Ver.  Tower ops and the Miller loop run in
-the suite; the full pairing + final exponentiation compile takes
-~12 min on CPU, so those asserts are gated behind FMT_SLOW_TESTS=1 —
-their correctness is additionally pinned by the in-suite Miller
-differential plus the host-path batch_verify test.)
+idemix/signature.go:243 Ver.)
+
+Tier-1 cost discipline (VERDICT r6 #3 — the <8-minute suite target):
+the always-on slice is the tower ops, the JITTED Miller loop against
+the PERSISTED pairing fixture (tests/_fixtures/
+fp256bn_pairing_vectors.json — host Miller/pairing values for points
+pinned by a dedicated seed; regenerate by deleting the file and
+running once), and the final exponentiation's EASY part.  The eager
+device final exponentiation (~11 min of op-by-op dispatch on CPU —
+it alone used to cost more than the rest of the suite combined) and
+the fused jitted pairing program are gated behind FMT_SLOW_TESTS=1;
+between the Miller differential, the easy-part check, and the
+host-path batch_verify test, the verdict path stays exercised on
+every run.
 """
+import json
 import os
 import random
 
@@ -21,6 +31,9 @@ from fabric_mod_tpu.ops import limbs9 as limbs
 
 rng = random.Random(2024)
 P = host.P
+
+_VEC_PATH = os.path.join(os.path.dirname(__file__), "_fixtures",
+                         "fp256bn_pairing_vectors.json")
 
 
 def rand_fp2():
@@ -94,41 +107,125 @@ def test_fp12_ops_match_host():
     assert dev.f12_to_host(dev.f12_frobenius(dx)) == x.frobenius()
 
 
+# --- the persisted pairing fixture -----------------------------------------
+
+def _ser_fp12(x) -> list:
+    return [hex(v) for v in (
+        x.c0.c0.a, x.c0.c0.b, x.c0.c1.a, x.c0.c1.b,
+        x.c0.c2.a, x.c0.c2.b, x.c1.c0.a, x.c1.c0.b,
+        x.c1.c1.a, x.c1.c1.b, x.c1.c2.a, x.c1.c2.b)]
+
+
+def _de_fp12(vals) -> "host.Fp12":
+    v = [int(s, 16) for s in vals]
+
+    def fp6(o):
+        return host.Fp6(host.Fp2(v[o], v[o + 1]),
+                        host.Fp2(v[o + 2], v[o + 3]),
+                        host.Fp2(v[o + 4], v[o + 5]))
+    return host.Fp12(fp6(0), fp6(6))
+
+
 @pytest.fixture(scope="module")
 def points():
+    """Pinned by a DEDICATED seed (not the module rng, whose draw
+    position depends on which tests ran first): the fixture vectors
+    on disk stay valid under any test selection."""
+    prng = random.Random(0x5EED)
     g2 = host.g2_generator()
-    w = rng.randrange(host.R)
+    w = prng.randrange(host.R)
     return {
         "g2": g2,
         "W": host.g2_mul(w, g2),
         "w": w,
-        "P1": host.g1_mul(rng.randrange(host.R), host.G1.generator()),
-        "P2": host.g1_mul(rng.randrange(host.R), host.G1.generator()),
+        "P1": host.g1_mul(prng.randrange(host.R), host.G1.generator()),
+        "P2": host.g1_mul(prng.randrange(host.R), host.G1.generator()),
     }
 
 
-def test_miller_loop_and_full_pairing_match_host(points):
-    """The batched scan Miller loop (sparse lines, shared-G2 schedule)
-    equals the host's generic Fp12 Miller loop — and composing the
-    device FINAL EXPONENTIATION on the Miller output reproduces the
-    host's full pairing.  The final exp runs EAGERLY: jitting it costs
-    >9 min of XLA compile on CPU while eager dispatch finishes in ~3,
-    so the full e(P, W) equation is exercised on every suite run with
-    no env gate (the jitted single-program variant stays behind
-    FMT_SLOW_TESTS for on-chip sessions)."""
+@pytest.fixture(scope="module")
+def vectors(points):
+    """Host Miller-loop + full-pairing values for the pinned points,
+    persisted at tests/_fixtures/fp256bn_pairing_vectors.json: the
+    always-on device differentials compare against these without
+    recomputing host pairings, and the slow tier re-derives them from
+    scratch to catch fixture drift.  Delete the file to regenerate."""
+    key = {"P1": [hex(points["P1"].x), hex(points["P1"].y)],
+           "P2": [hex(points["P2"].x), hex(points["P2"].y)],
+           "w": hex(points["w"])}
+    if os.path.exists(_VEC_PATH):
+        with open(_VEC_PATH) as fh:
+            data = json.load(fh)
+        if data.get("points") == key:
+            return {k: (_de_fp12(data[k][0]), _de_fp12(data[k][1]))
+                    for k in ("miller", "pairing")}
+    data = {
+        "comment": "host fp256bn Miller/pairing vectors for the "
+                   "seed-0x5EED points; regenerated by "
+                   "tests/test_fp256bn_dev.py when absent",
+        "points": key,
+        "miller": [_ser_fp12(host.miller_loop(points[p], points["W"]))
+                   for p in ("P1", "P2")],
+        "pairing": [_ser_fp12(host.pairing(points[p], points["W"]))
+                    for p in ("P1", "P2")],
+    }
+    os.makedirs(os.path.dirname(_VEC_PATH), exist_ok=True)
+    with open(_VEC_PATH, "w") as fh:
+        json.dump(data, fh, indent=1)
+    return {k: (_de_fp12(data[k][0]), _de_fp12(data[k][1]))
+            for k in ("miller", "pairing")}
+
+
+@pytest.fixture(scope="module")
+def miller_out(points):
+    """The jitted batched Miller output for the pinned points (shared
+    by the always-on differential and the easy-part check)."""
     import jax
     sched = dev.line_schedule(points["W"])
     xs, ys = dev._g1_batch_to_mont_np([points["P1"], points["P2"]])
-    f = jax.jit(lambda x, y: dev.miller_batch(x, y, sched))(xs, ys)
-    assert dev.f12_to_host(f, 0) == host.miller_loop(points["P1"],
-                                                     points["W"])
-    assert dev.f12_to_host(f, 1) == host.miller_loop(points["P2"],
-                                                     points["W"])
-    out = dev.final_exp_batch(f)           # eager by design, see above
-    assert dev.f12_to_host(out, 0) == host.pairing(points["P1"],
-                                                   points["W"])
-    assert dev.f12_to_host(out, 1) == host.pairing(points["P2"],
-                                                   points["W"])
+    return jax.jit(lambda x, y: dev.miller_batch(x, y, sched))(xs, ys)
+
+
+def test_miller_loop_matches_pinned_vectors(points, vectors,
+                                            miller_out):
+    """The batched scan Miller loop (sparse lines, shared-G2 schedule)
+    equals the host's generic Fp12 Miller loop — compared against the
+    persisted vectors, so tier-1 pays one jitted Miller program and
+    zero host pairings."""
+    assert dev.f12_to_host(miller_out, 0) == vectors["miller"][0]
+    assert dev.f12_to_host(miller_out, 1) == vectors["miller"][1]
+
+
+def test_final_exp_easy_part_matches_host(vectors, miller_out):
+    """The final exponentiation's EASY part (conj/inv + double
+    Frobenius — no u-chain scans, so eager dispatch stays cheap)
+    against the same composition in host Fp12.  The hard part (the
+    3x63-step cyclotomic scans that cost ~11 min of eager CPU
+    dispatch) runs in the FMT_SLOW_TESTS tier below."""
+    f = dev.f12_mul(dev.f12_conj(miller_out), dev.f12_inv(miller_out))
+    f = dev.f12_mul(dev.f12_frobenius(dev.f12_frobenius(f)), f)
+    for i in (0, 1):
+        m = vectors["miller"][i]
+        want = m.conj() * m.inv()
+        want = want.frobenius().frobenius() * want
+        assert dev.f12_to_host(f, i) == want
+
+
+@pytest.mark.skipif(not os.environ.get("FMT_SLOW_TESTS"),
+                    reason="eager device final exp ~11min CPU "
+                    "dispatch; the Miller differential + easy-part "
+                    "check pin the in-suite coverage")
+def test_full_pairing_composition_matches_host(points, vectors,
+                                               miller_out):
+    """Composing the device FINAL EXPONENTIATION (eager: jitting it
+    costs >9 min of XLA compile on CPU) on the Miller output
+    reproduces the host's full pairing — re-derived from scratch
+    here, which also cross-checks the persisted fixture."""
+    out = dev.final_exp_batch(miller_out)
+    for i, p in enumerate(("P1", "P2")):
+        want = host.pairing(points[p], points["W"])
+        assert want == vectors["pairing"][i]      # fixture drift check
+        assert dev.f12_to_host(out, i) == want
 
 
 def test_line_schedule_is_cached(points):

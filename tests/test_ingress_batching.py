@@ -78,6 +78,7 @@ def test_e2e_with_ingress_batching(tmp_path):
                 break
             time.sleep(0.05)
         client.stop()
+        t.join(timeout=30)   # run() closes its pipe before returning
         assert committed == 10
         qe = net.ledger.new_query_executor()
         assert qe.get_state("mycc", "bk3") == b"bv3"

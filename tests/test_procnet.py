@@ -225,6 +225,28 @@ class ProcNet:
                 return oid
         return None
 
+    def leader_known_by_all(self):
+        """Cross-process analog of _clocksteps.leader_known_by_all:
+        exactly one LIVE orderer leads and every live orderer's raft
+        layer reports a leader it believes in (all agreeing).
+        Ordering through a follower before this point is legitimately
+        lossy — a leaderless follower DROPS forwarded submits (clients
+        retry, by design) — so submit-through-follower phases must
+        gate on this, not on `leader() is not None`."""
+        leaders, known = [], []
+        for oid in self.o_ids:
+            if self.procs[oid].poll() is not None:
+                continue
+            try:
+                chan = self.orderer_channels(oid)["channels"][0]
+            except Exception:
+                return False
+            if chan.get("is_leader"):
+                leaders.append(oid)
+            known.append(chan.get("leader_id"))
+        return (len(leaders) == 1 and len(known) > 1
+                and all(k is not None and k == known[0] for k in known))
+
     def peer_height(self, pid):
         return _metric_value(
             f"http://127.0.0.1:{self.pops[pid]}/metrics",
@@ -286,15 +308,19 @@ def test_process_network_survives_leader_kill(procnet):
     net = procnet
     net.start_all()
 
-    # all orderers up with the channel, a leader elected
+    # all orderers up with the channel, a leader elected AND known to
+    # every consenter — phase 1 submits through a FOLLOWER, which
+    # silently drops forwards until it learns the leader (budgets are
+    # wide: 5 OS processes under full-suite CPU contention elect
+    # slowly; _wait exits the moment the predicate holds)
     assert _wait(lambda: all(
         net.orderer_channels(o)["channels"][0]["height"] >= 1
-        for o in net.o_ids), t=60), "orderers did not come up"
-    assert _wait(lambda: net.leader() is not None, t=60), \
-        "no raft leader elected"
+        for o in net.o_ids), t=150), "orderers did not come up"
+    assert _wait(net.leader_known_by_all, t=150), \
+        "no raft leader elected/propagated"
     # both peers committed genesis
     assert _wait(lambda: all(net.peer_height(p) >= 1
-                             for p in ("p0", "p1")), t=60), \
+                             for p in ("p0", "p1")), t=150), \
         "peers did not bootstrap"
 
     # phase 1: txs through a follower (tests submit forwarding too)
@@ -303,7 +329,7 @@ def test_process_network_survives_leader_kill(procnet):
     net.submit_txs(follower, 0, 6)
     # 6 txs / MaxMessageCount 5 -> at least 2 blocks past genesis
     assert _wait(lambda: all((net.peer_height(p) or 0) >= 3
-                             for p in ("p0", "p1")), t=60), (
+                             for p in ("p0", "p1")), t=150), (
         "peers did not commit phase-1 txs: heights "
         f"{[net.peer_height(p) for p in ('p0', 'p1')]}")
 
@@ -313,12 +339,12 @@ def test_process_network_survives_leader_kill(procnet):
     leader = net.leader()
     net.kill(leader)
     survivors = [o for o in net.o_ids if o != leader]
-    assert _wait(lambda: net.leader() in survivors, t=90), \
+    assert _wait(lambda: net.leader() in survivors, t=240), \
         "no re-election after leader SIGKILL"
     net.submit_txs(net.leader(), 6, 6)
     h0 = net.peer_height("p0")
     assert _wait(lambda: all((net.peer_height(p) or 0) >= (h0 or 1) + 1
-                             for p in ("p0", "p1")), t=90), (
+                             for p in ("p0", "p1")), t=240), (
         "peers did not commit after leader kill: heights "
         f"{[net.peer_height(p) for p in ('p0', 'p1')]}")
 
@@ -327,7 +353,7 @@ def test_process_network_survives_leader_kill(procnet):
                for o in survivors}
     assert _wait(lambda: len({
         net.orderer_channels(o)["channels"][0]["height"]
-        for o in survivors}) == 1, t=30), f"divergent heights {heights}"
+        for o in survivors}) == 1, t=90), f"divergent heights {heights}"
 
 
 def test_chaincode_cli_invoke_and_query_across_processes(procnet):
@@ -339,9 +365,12 @@ def test_chaincode_cli_invoke_and_query_across_processes(procnet):
 
     net = procnet
     net.start_all()
-    assert _wait(lambda: net.leader() is not None, t=60)
+    # the invoke broadcasts through o0 specifically, which may be a
+    # follower: wait until every consenter knows the leader or the
+    # forwarded submit is legitimately dropped
+    assert _wait(net.leader_known_by_all, t=150)
     assert _wait(lambda: all(net.peer_height(p) >= 1
-                             for p in ("p0", "p1")), t=60)
+                             for p in ("p0", "p1")), t=150)
 
     peers = ",".join(f"127.0.0.1:{net.eports[p]}" for p in ("p0", "p1"))
     rc = chaincode_main([
@@ -355,7 +384,7 @@ def test_chaincode_cli_invoke_and_query_across_processes(procnet):
     assert rc == 0
     # both peers commit the invoke
     assert _wait(lambda: all((net.peer_height(p) or 0) >= 2
-                             for p in ("p0", "p1")), t=60)
+                             for p in ("p0", "p1")), t=150)
 
     # invoke --wait-event: the client learns its tx's validation code
     # from the peer's DeliverFiltered event stream (reference:

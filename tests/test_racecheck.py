@@ -1,5 +1,5 @@
 """Race-detection tier: lock hierarchy + thread ownership + seeded
-interleaving stress.
+interleaving stress + the FMT_RACECHECK canaries.
 
 (reference: scripts/run-unit-tests.sh:142-161 — the Go race detector
 over the unit suite.  SURVEY §5.2's analog here: OrderedLock turns
@@ -8,15 +8,38 @@ cross-thread FSM mutation into immediate failures, and the seeded
 stress below drives the REAL shared structures (kvledger commit vs
 readers, transient store writers) through many interleavings.  The
 canary tests prove the detectors bite: an injected inversion and an
-injected cross-thread call must raise.)
+injected cross-thread call must raise.
+
+The second half is the per-structure canary convention for the
+fabric_mod_tpu/concurrency subsystem: for EVERY retrofitted threaded
+structure (gossip comm senders, the BatchingVerifyService flusher,
+the deliverclient puller, the commit pipeline, election, the gossip
+drain loop) one injected race must raise with the guards armed
+(`concurrency.armed()` — the same switch FMT_RACECHECK=1 throws for
+the whole suite) and stay silent with them off.)
 """
+import queue as _stdqueue
 import random
 import threading
+import time
 
 import pytest
 
+from fabric_mod_tpu import concurrency
+from fabric_mod_tpu.concurrency import (GuardedQueue, RegisteredLock,
+                                        RegisteredThread, armed,
+                                        assert_joined, lock_registry)
 from fabric_mod_tpu.utils.racecheck import (OrderedLock, RaceError,
                                             ThreadOwnership)
+
+
+def _spin(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return pred()
 
 
 # --- canaries: injected races MUST be caught -------------------------------
@@ -213,3 +236,278 @@ def test_seeded_stress_ledger_commit_vs_readers(tmp_path, seed):
             t.join(timeout=5)
     assert not errs, errs
     assert led.height == 30
+
+
+# --- the concurrency-subsystem primitives ----------------------------------
+
+def test_registry_cycle_detection_direct_and_transitive():
+    """The dynamic lock-order registry: the FIRST acquisition that
+    closes a cycle raises — directly (AB/BA) and transitively
+    (A->B->C then C->A)."""
+    with armed():
+        a, b, c = (RegisteredLock(n) for n in "abc")
+        with a:
+            with b:
+                with c:
+                    pass
+        with c:
+            with pytest.raises(RaceError, match="lock-order cycle"):
+                a.acquire()
+        # re-entry stays exempt
+        with a:
+            with a:
+                with b:
+                    pass
+
+
+def test_registry_spans_ranked_and_rankless_locks():
+    """OrderedLock feeds the same registry: an inversion between a
+    ranked ledger-style lock and a rank-less structure lock is a
+    cycle even though neither detector alone would see it."""
+    with armed():
+        ranked = OrderedLock(40, "ranked-canary")
+        free = RegisteredLock("rankless-canary")
+        with ranked:
+            with free:
+                pass
+        with free:
+            with pytest.raises(RaceError, match="lock-order cycle"):
+                ranked.acquire()
+
+
+def test_guarded_queue_consumer_pin_and_dead_owner_handoff():
+    with armed():
+        q = GuardedQueue(name="canary-q")
+        bound = threading.Event()
+
+        def consumer():
+            q.get()                        # binds ownership
+            bound.set()
+            threading.Event().wait(10)     # stay alive, owning
+
+        t = threading.Thread(target=consumer, daemon=True)
+        q.put(1)
+        t.start()
+        assert bound.wait(5)
+        with pytest.raises(RaceError, match="consumer-side ownership"):
+            q.get_nowait()                 # live owner bypassed
+        # dead-owner handoff: a terminated consumer releases the pin
+        q2 = GuardedQueue(name="canary-q2")
+        t2 = threading.Thread(target=q2.put, args=(1,))
+        t2.start()
+        t2.join()
+        done = threading.Thread(target=lambda: q2.get())
+        done.start()
+        done.join()
+        q2.put(2)
+        assert q2.get_nowait() == 2        # join = happens-before
+
+
+def test_registered_thread_leak_check_bites():
+    release = threading.Event()
+    t = RegisteredThread(target=release.wait, name="canary-leaker",
+                         structure="canary")
+    t.start()
+    with armed():
+        with pytest.raises(RaceError, match="thread leak"):
+            assert_joined((t,), owner="canary", timeout=0.05)
+    with armed(False):
+        assert_joined((t,), owner="canary", timeout=0.05)  # silent
+    release.set()
+    t.join(5)
+    assert t not in concurrency.live_registered()
+
+
+# --- per-structure injected-race canaries ----------------------------------
+# One per retrofitted structure: the guard must raise with the checks
+# armed (what FMT_RACECHECK=1 does suite-wide) and stay silent off.
+
+class _NullLedger:
+    height = 0
+
+    height_changed = threading.Condition()
+
+    def get_block_by_number(self, n):
+        return None
+
+
+class _NullStaged:
+    def __init__(self, block):
+        self.block = block
+        self.needs_barrier = False
+
+    def resolve_mask(self):
+        return None
+
+
+class _NullTarget:
+    ledger = _NullLedger()
+
+    def stage_block(self, block):
+        return _NullStaged(block)
+
+    def commit_staged(self, staged):
+        return []
+
+
+def _block0():
+    from fabric_mod_tpu.protos import protoutil
+    return protoutil.new_block(0, b"", [])
+
+
+def test_canary_batching_verify_service_flusher_bites():
+    """Stealing from the flusher's submit queue (or the resolver's
+    in-flight queue) from outside the owning thread raises."""
+    from fabric_mod_tpu.bccsp.api import VerifyItem
+    from fabric_mod_tpu.bccsp.tpu import (BatchingVerifyService,
+                                          FakeBatchVerifier)
+    with armed():
+        svc = BatchingVerifyService(FakeBatchVerifier(),
+                                    deadline_s=0.001)
+        try:
+            # one verdict round-trip proves both workers bound their
+            # queue sides while armed
+            svc.verify(VerifyItem(b"\x11" * 32, b"junk", b"\x00" * 64),
+                       timeout=30)
+            with pytest.raises(RaceError, match="consumer-side"):
+                svc._q.get_nowait()
+            with pytest.raises(RaceError, match="consumer-side"):
+                svc._inflight.get_nowait()
+            with armed(False):             # silent when off
+                with pytest.raises(_stdqueue.Empty):
+                    svc._q.get_nowait()
+        finally:
+            svc.close()                    # leak-checked join, armed
+
+
+def test_canary_commitpipe_stage_commit_queues_bite(tmp_path):
+    from fabric_mod_tpu.peer.commitpipe import PipelinedCommitter
+    with armed():
+        pipe = PipelinedCommitter(_NullTarget(), depth=2)
+        try:
+            pipe.submit(_block0())
+            assert pipe.flush(timeout_s=10)
+            with pytest.raises(RaceError, match="consumer-side"):
+                pipe._in_q.get_nowait()    # stage loop owns
+            with pytest.raises(RaceError, match="consumer-side"):
+                pipe._staged_q.get_nowait()  # commit loop owns
+            with armed(False):
+                with pytest.raises(_stdqueue.Empty):
+                    pipe._in_q.get_nowait()
+        finally:
+            pipe.close()
+
+
+def test_canary_gossip_comm_sender_bites():
+    """A second thread draining a destination's send queue is exactly
+    the lost/reordered-traffic race; the sender thread owns it."""
+    pytest.importorskip("grpc")
+    from fabric_mod_tpu.gossip.comm import GRPCGossipNetwork
+    with armed():
+        net = GRPCGossipNetwork()
+        net.start()
+        try:
+            # destination nobody serves: payload parks in the queue
+            # behind a sender thread that owns the consumer side
+            assert net.send("me", b"pki", "127.0.0.1:9", b"env")
+            q = net._queues["127.0.0.1:9"]
+            assert _spin(lambda: q._consumer._owner is not None)
+            with pytest.raises(RaceError, match="consumer-side"):
+                q.get_nowait()
+            with armed(False):
+                with pytest.raises(_stdqueue.Empty):
+                    # the sender drained the payload (send attempts
+                    # fail against the dead endpoint) — get is silent
+                    _spin(lambda: q.qsize() == 0)
+                    q.get_nowait()
+        finally:
+            net.stop()                     # leak-checked sender join
+
+
+def test_canary_deliverclient_double_run_bites():
+    """Two concurrent run() loops on one client double-pull and
+    double-submit; the second claim must raise while the first runner
+    is alive, and sequential re-runs stay legal."""
+    from fabric_mod_tpu.peer.deliverclient import DeliverClient
+
+    stop_src = threading.Event()
+    entered = threading.Event()
+
+    class _Source:
+        def blocks(self, start, stop=None, stop_event=None,
+                   timeout_s=30.0):
+            entered.set()
+            stop_src.wait(20)
+            return iter(())
+
+    class _Chan:
+        ledger = _NullLedger()
+        channel_id = "canary"
+
+        class mcs:
+            @staticmethod
+            def verify_block(cid, block, expected_prev_hash=None):
+                return None
+
+        def stage_block(self, block):
+            return _NullStaged(block)
+
+        def commit_staged(self, staged):
+            return []
+
+    dc = DeliverClient(_Chan(), _Source())
+    t = threading.Thread(target=dc.run, daemon=True)
+    t.start()
+    try:
+        assert entered.wait(5)
+        with armed():
+            with pytest.raises(RaceError, match="concurrent ownership"):
+                dc._runner.claim()
+        with armed(False):
+            dc._runner.claim()             # silent when off
+    finally:
+        stop_src.set()
+        dc.stop()
+        t.join(10)
+    assert not t.is_alive()
+
+
+def test_canary_election_external_tick_bites():
+    from fabric_mod_tpu.gossip.election import LeaderElectionService
+    svc = LeaderElectionService(b"\x01", lambda: [])
+    svc.start(interval_s=0.02)
+    try:
+        assert _spin(lambda: svc._ticker._owner is not None)
+        with armed():
+            with pytest.raises(RaceError, match="thread-ownership"):
+                svc.tick()                 # the loop owns ticking
+        with armed(False):
+            svc.tick()                     # silent when off
+    finally:
+        with armed():
+            svc.stop()                     # leak-checked join
+    with armed():
+        svc.tick()                         # owner dead: legal again
+
+
+def test_canary_gossip_state_drain_lock_in_registry():
+    """The drain lock participates in cycle detection: an inversion
+    against any other registered lock is reported on the second
+    ordering, on the real provider instance."""
+    from fabric_mod_tpu.gossip.state import GossipStateProvider
+
+    class _Chan:
+        ledger = _NullLedger()
+
+        def store_block(self, block):
+            return []
+
+    prov = GossipStateProvider(_Chan())
+    probe = RegisteredLock("canary-probe")
+    with armed():
+        with prov._drain_lock:
+            with probe:
+                pass
+        with probe:
+            with pytest.raises(RaceError, match="lock-order cycle"):
+                prov._drain_lock.acquire()

@@ -4,20 +4,36 @@ WAL restart recovery, and a 3-orderer cluster ordering real blocks.
 (reference test model: integration/raft/cft_test.go:47 — kill/restart
 orderers and keep ordering — shrunk to in-process nodes over the
 transport seam, plus protocol-level unit coverage.)
+
+ELECTION timing runs on utils/fakeclock.ManualClock throughout (the
+deterministic-clock tier: only explicit `clock.advance` calls move
+election/heartbeat deadlines, so CPU load can neither fire spurious
+elections nor miss heartbeats — etcd/raft's tick-driven test model).
+Replication/commit propagation is message-driven and needs no clock;
+`_wait` only polls for FSM threads to process queued messages.  One
+REAL-time smoke stays wall-clock (test_single_node_cluster_commits)
+so the production time source keeps end-to-end coverage.
 """
 import os
+import random
 import threading
 import time
+import zlib
 
 import pytest
+
+from tests._clocksteps import advance_until, leader_known_by_all
 
 from fabric_mod_tpu.orderer.raft import RaftNode, RaftTransport
 from fabric_mod_tpu.orderer.raftchain import RaftChain
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
+from fabric_mod_tpu.utils.fakeclock import ManualClock
 
 
 def _wait(pred, timeout=10.0, step=0.02):
+    """Real-time poll for MESSAGE-driven progress (thread scheduling
+    only — never for timer-driven transitions; those take the clock)."""
     deadline = time.time() + timeout
     while time.time() < deadline:
         if pred():
@@ -26,7 +42,20 @@ def _wait(pred, timeout=10.0, step=0.02):
     return False
 
 
-def _make_cluster(tmp_path, n=3):
+def _advance_until(clock, pred, step=0.02, max_steps=150):
+    return advance_until(clock, pred, step=step, max_steps=max_steps)
+
+
+def _seeded_rng(i):
+    """Distinct deterministic seeds (crc32, not hash() — str hashing
+    is randomized per process, and colliding seeds draw identical
+    election timeouts, the exact split-vote flake this tier removes):
+    the draw order (and so the election winner) is a property of the
+    seed, not the scheduler."""
+    return random.Random(0xE1EC + zlib.crc32(i.encode()))
+
+
+def _make_cluster(tmp_path, n=3, clock=None):
     transport = RaftTransport()
     ids = [f"n{i}" for i in range(n)]
     applied = {i: [] for i in ids}
@@ -34,26 +63,31 @@ def _make_cluster(tmp_path, n=3):
     for i in ids:
         nodes[i] = RaftNode(
             i, ids, transport, str(tmp_path / f"{i}.wal"),
-            lambda idx, data, i=i: applied[i].append((idx, data)))
+            lambda idx, data, i=i: applied[i].append((idx, data)),
+            clock=clock, rng=_seeded_rng(i) if clock else None)
     for node in nodes.values():
         node.start()
     return transport, ids, nodes, applied
 
 
-def _leader(nodes, timeout=10.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        leaders = [n for n in nodes.values() if n.state == "leader"]
-        if len(leaders) == 1:
-            return leaders[0]
-        time.sleep(0.02)
-    raise AssertionError("no single leader elected")
+def _leader(nodes, clock=None, timeout=10.0):
+    def one_leader():
+        return sum(n.state == "leader" for n in nodes.values()) == 1
+
+    if clock is not None:
+        ok = _advance_until(clock, one_leader)
+    else:
+        ok = _wait(one_leader, timeout=timeout)
+    if not ok:
+        raise AssertionError("no single leader elected")
+    return next(n for n in nodes.values() if n.state == "leader")
 
 
 def test_election_and_replication(tmp_path):
-    transport, ids, nodes, applied = _make_cluster(tmp_path)
+    clock = ManualClock()
+    transport, ids, nodes, applied = _make_cluster(tmp_path, clock=clock)
     try:
-        leader = _leader(nodes)
+        leader = _leader(nodes, clock)
         for i in range(20):
             assert leader.propose(b"entry%d" % i)
         ok = _wait(lambda: all(
@@ -67,25 +101,29 @@ def test_election_and_replication(tmp_path):
 
 
 def test_leader_failure_and_reelection(tmp_path):
-    transport, ids, nodes, applied = _make_cluster(tmp_path)
+    clock = ManualClock()
+    transport, ids, nodes, applied = _make_cluster(tmp_path, clock=clock)
     try:
-        leader = _leader(nodes)
+        leader = _leader(nodes, clock)
         for i in range(5):
             leader.propose(b"a%d" % i)
         assert _wait(lambda: all(len(applied[i]) == 5 for i in ids))
-        # partition the leader away (crash-equivalent)
+        # partition the leader away (crash-equivalent); only explicit
+        # advances can expire the remaining followers' election timers
         transport.partitioned.add(leader.id)
         rest = {i: n for i, n in nodes.items() if i != leader.id}
-        new_leader = _leader(rest, timeout=15.0)
+        new_leader = _leader(rest, clock)
         assert new_leader.id != leader.id
         for i in range(5):
             new_leader.propose(b"b%d" % i)
         others = [i for i in rest]
         assert _wait(lambda: all(len(applied[i]) == 10 for i in others))
-        # heal: the old leader catches up and steps down
+        # heal: the new leader's next (clock-driven) heartbeat catches
+        # the old leader up and forces its step-down
         transport.partitioned.clear()
-        assert _wait(lambda: len(applied[leader.id]) == 10, timeout=15.0)
-        assert _wait(lambda: leader.state != "leader", timeout=15.0)
+        assert _advance_until(clock,
+                              lambda: len(applied[leader.id]) == 10)
+        assert _advance_until(clock, lambda: leader.state != "leader")
         # logs identical everywhere
         seqs = {i: [d for _, d in applied[i]] for i in ids}
         assert len(set(map(tuple, seqs.values()))) == 1
@@ -95,9 +133,10 @@ def test_leader_failure_and_reelection(tmp_path):
 
 
 def test_wal_restart_recovers_state(tmp_path):
-    transport, ids, nodes, applied = _make_cluster(tmp_path)
+    clock = ManualClock()
+    transport, ids, nodes, applied = _make_cluster(tmp_path, clock=clock)
     try:
-        leader = _leader(nodes)
+        leader = _leader(nodes, clock)
         for i in range(8):
             leader.propose(b"x%d" % i)
         assert _wait(lambda: all(len(applied[i]) == 8 for i in ids))
@@ -109,16 +148,19 @@ def test_wal_restart_recovers_state(tmp_path):
         applied[victim] = []
         revived = RaftNode(
             victim, ids, transport, str(tmp_path / f"{victim}.wal"),
-            lambda idx, data: applied[victim].append((idx, data)))
+            lambda idx, data: applied[victim].append((idx, data)),
+            clock=clock, rng=_seeded_rng(victim))
         assert revived._wal.term >= term_before
         assert revived._wal.entries == log_before
         revived.start()
         nodes[victim] = revived
-        leader2 = _leader(nodes)
+        leader2 = _leader(nodes, clock)
         leader2.propose(b"after-restart")
-        assert _wait(
-            lambda: applied[victim] and
-            applied[victim][-1][1] == b"after-restart", timeout=15.0)
+        # the revived follower needs one (clock-driven) append/
+        # heartbeat round to be repaired up to the new entry
+        assert _advance_until(
+            clock, lambda: applied[victim] and
+            applied[victim][-1][1] == b"after-restart")
     finally:
         for n in nodes.values():
             n.stop()
@@ -126,7 +168,12 @@ def test_wal_restart_recovers_state(tmp_path):
 
 def test_single_node_cluster_commits(tmp_path):
     """A 1-node raft channel must order (quorum of 1) — regression:
-    commit advancement must not depend on follower replies."""
+    commit advancement must not depend on follower replies.
+
+    THE real-time smoke of this suite: deliberately wall-clock (the
+    production `time.monotonic` source elects here), so the fake-clock
+    migration of every other election assertion can never mask a
+    broken real timer path."""
     transport = RaftTransport()
     applied = []
     node = RaftNode("solo", ["solo"], transport,
@@ -147,7 +194,8 @@ def test_single_node_cluster_commits(tmp_path):
 @pytest.fixture()
 def cluster(tmp_path):
     """3 orderer nodes, each with its own registrar/store/raft chain,
-    sharing one genesis."""
+    sharing one genesis.  Elections run on one shared ManualClock
+    (world["clock"]); only explicit advances move election timers."""
     from fabric_mod_tpu.bccsp.sw import SwCSP
     from fabric_mod_tpu.channelconfig import genesis
     from fabric_mod_tpu.msp import ca as calib
@@ -163,6 +211,7 @@ def cluster(tmp_path):
         consensus_type="etcdraft", batch_timeout="150ms",
         max_message_count=10)
 
+    clock = ManualClock()
     transport = RaftTransport()
     ids = ["o0", "o1", "o2"]
     registrars = {}
@@ -174,13 +223,14 @@ def cluster(tmp_path):
 
         def factory(support, i=i):
             return RaftChain(i, ids, transport,
-                             str(tmp_path / f"{i}.wal"), support)
+                             str(tmp_path / f"{i}.wal"), support,
+                             clock=clock, rng=_seeded_rng(i))
         reg = Registrar(str(tmp_path / i), signer, csp,
                         chain_factory=factory)
         reg.create_channel(blk)
         registrars[i] = reg
     world = {
-        "csp": csp, "org_ca": org_ca, "ids": ids,
+        "csp": csp, "org_ca": org_ca, "ids": ids, "clock": clock,
         "transport": transport, "registrars": registrars,
         "supports": {i: registrars[i].get_chain("raftchan")
                      for i in ids},
@@ -211,8 +261,8 @@ def test_raft_cluster_orders_identical_chains(cluster):
     world = cluster
     supports = world["supports"]
     chains = {i: s.chain for i, s in supports.items()}
-    assert _wait(lambda: any(c.is_leader for c in chains.values()),
-                 timeout=15.0)
+    assert _advance_until(world["clock"],
+                          lambda: leader_known_by_all(chains))
     # submit through a FOLLOWER: forwarding must reach the leader
     follower = next(i for i, c in chains.items() if not c.is_leader)
     for i in range(25):
@@ -243,8 +293,8 @@ def test_raft_chain_restart_does_not_duplicate_blocks(cluster, tmp_path):
     world = cluster
     supports = world["supports"]
     chains = {i: s.chain for i, s in supports.items()}
-    assert _wait(lambda: any(c.is_leader for c in chains.values()),
-                 timeout=15.0)
+    assert _advance_until(world["clock"],
+                          lambda: leader_known_by_all(chains))
     any_id = world["ids"][0]
     for i in range(15):
         supports[any_id].chain.order(_client_env(world, i), 0)
@@ -263,7 +313,8 @@ def test_raft_chain_restart_does_not_duplicate_blocks(cluster, tmp_path):
 
     def factory(support, i=victim):
         return RaftChain(i, world["ids"], world["transport"],
-                         str(tmp_path / f"{i}.wal"), support)
+                         str(tmp_path / f"{i}.wal"), support,
+                         clock=world["clock"], rng=_seeded_rng(i))
     from fabric_mod_tpu.msp import ca as calib
     from fabric_mod_tpu.msp.identities import SigningIdentity
     ocert, okey = world["org_ca"].issue("x", "Org1", ous=["orderer"])
@@ -274,10 +325,11 @@ def test_raft_chain_restart_does_not_duplicate_blocks(cluster, tmp_path):
     world["registrars"][victim] = reg2
     support2 = reg2.get_chain("raftchan")
     world["supports"][victim] = support2
-    # after WAL replay + leader catch-up: same height, same tip, and
+    # after WAL replay + leader catch-up (one clock-driven heartbeat
+    # round repairs the revived follower): same height, same tip, and
     # every pre-restart block unchanged (no duplicates appended)
-    assert _wait(lambda: support2.store.height >= height_before,
-                 timeout=20.0)
+    assert _advance_until(world["clock"],
+                          lambda: support2.store.height >= height_before)
     assert protoutil.block_header_hash(
         support2.store.get_block_by_number(height_before - 1).header
     ) == tip_hash
@@ -297,8 +349,9 @@ def test_raft_cluster_survives_leader_kill(cluster):
     world = cluster
     supports = world["supports"]
     chains = {i: s.chain for i, s in supports.items()}
-    assert _wait(lambda: any(c.is_leader for c in chains.values()),
-                 timeout=15.0)
+    assert _advance_until(world["clock"],
+                          lambda: any(c.is_leader
+                                      for c in chains.values()))
     leader_id = next(i for i, c in chains.items() if c.is_leader)
     for i in range(12):
         supports[leader_id].chain.order(_client_env(world, i), 0)
@@ -307,12 +360,14 @@ def test_raft_cluster_survives_leader_kill(cluster):
             for b in range(1, s.store.height)) >= 12
         for s in supports.values()), timeout=20.0)
 
-    # kill the leader (partition both raft + chain endpoints)
+    # kill the leader (partition both raft + chain endpoints); the
+    # survivors' election timers expire only under explicit advances
     world["transport"].partitioned.update(
         {leader_id, f"{leader_id}:chain"})
     rest = {i: c for i, c in chains.items() if i != leader_id}
-    assert _wait(lambda: any(c.is_leader for c in rest.values()),
-                 timeout=20.0)
+    assert _advance_until(world["clock"],
+                          lambda: any(c.is_leader
+                                      for c in rest.values()))
     survivor = next(i for i, c in rest.items() if c.is_leader)
     for i in range(12, 24):
         supports[survivor].chain.order(_client_env(world, i), 0)
@@ -336,16 +391,18 @@ def test_compaction_bounds_wal_and_survives_restart(tmp_path):
     """snapshot_interval folds applied entries into a snapshot marker:
     the in-memory log and the WAL file stay bounded, and a restart
     resumes from the snapshot without re-applying compacted entries."""
+    clock = ManualClock()
     transport = RaftTransport()
     applied = []
     node = RaftNode("solo", ["solo"], transport,
                     str(tmp_path / "solo.wal"),
                     lambda idx, data: applied.append((idx, data)),
                     snapshot_interval=10,
-                    snapshot_cb=lambda: b"height-marker")
+                    snapshot_cb=lambda: b"height-marker",
+                    clock=clock, rng=_seeded_rng("solo"))
     node.start()
     try:
-        assert _wait(lambda: node.state == "leader", timeout=10.0)
+        assert _advance_until(clock, lambda: node.state == "leader")
         for i in range(37):
             node.propose(b"e%02d" % i)
         assert _wait(lambda: len(applied) == 37, timeout=10.0)
@@ -362,13 +419,14 @@ def test_compaction_bounds_wal_and_survives_restart(tmp_path):
                      str(tmp_path / "solo.wal"),
                      lambda idx, data: applied2.append((idx, data)),
                      snapshot_interval=10,
-                     snapshot_cb=lambda: b"height-marker")
+                     snapshot_cb=lambda: b"height-marker",
+                     clock=clock, rng=_seeded_rng("solo2"))
     assert node2._wal.snap_index >= 30
     assert node2._wal.snap_data == b"height-marker"
     assert node2.last_applied == node2._wal.snap_index
     node2.start()
     try:
-        assert _wait(lambda: node2.state == "leader", timeout=10.0)
+        assert _advance_until(clock, lambda: node2.state == "leader")
         node2.propose(b"after")
         assert _wait(lambda: any(d == b"after" for _, d in applied2),
                      timeout=10.0)
@@ -385,6 +443,7 @@ def test_install_snapshot_catches_up_lagging_follower(tmp_path):
     app-level install callback (reference: chain.go:880 catchUp)."""
     import json
 
+    clock = ManualClock()
     transport = RaftTransport()
     ids = ["a", "b", "c"]
     applied = {i: [] for i in ids}
@@ -405,13 +464,13 @@ def test_install_snapshot_catches_up_lagging_follower(tmp_path):
             i, ids, transport, str(tmp_path / f"{i}.wal"),
             lambda idx, data, i=i: applied[i].append((idx, data)),
             snapshot_interval=8, snapshot_cb=snap_cb,
-            install_cb=install_cb)
+            install_cb=install_cb, clock=clock, rng=_seeded_rng(i))
 
     for i in ids:
         nodes[i] = make(i)
         nodes[i].start()
     try:
-        leader = _leader(nodes)
+        leader = _leader(nodes, clock)
         follower = [i for i in ids if i != leader.id][0]
         for i in range(3):
             leader.propose(b"pre%d" % i)
@@ -425,14 +484,16 @@ def test_install_snapshot_catches_up_lagging_follower(tmp_path):
         assert _wait(lambda: all(len(applied[i]) == 33 for i in live),
                      timeout=15.0)
         assert _wait(lambda: leader._wal.snap_index > 10, timeout=10.0)
-        # heal: the follower needs compacted entries -> snapshot path
+        # heal: the follower needs compacted entries -> snapshot path,
+        # triggered by the leader's next clock-driven heartbeat
         transport.partitioned.clear()
-        assert _wait(lambda: [d for _, d in applied[follower]] ==
-                     [d for _, d in applied[leader.id]], timeout=20.0)
+        assert _advance_until(
+            clock, lambda: [d for _, d in applied[follower]] ==
+            [d for _, d in applied[leader.id]])
         assert installs[follower], "follower never received a snapshot"
         assert nodes[follower]._wal.snap_index >= 11
         # and it keeps replicating normally afterwards
-        leader2 = _leader(nodes)
+        leader2 = _leader(nodes, clock)
         leader2.propose(b"post")
         assert _wait(lambda: applied[follower] and
                      applied[follower][-1][1] == b"post", timeout=15.0)
@@ -460,6 +521,7 @@ def test_raft_chain_snapshot_catchup_pulls_blocks(cluster, tmp_path):
         consensus_type="etcdraft", batch_timeout="150ms",
         max_message_count=2)
 
+    clock = ManualClock()
     transport = RaftTransport()
     ids = ["s0", "s1", "s2"]
     registrars = {}
@@ -486,7 +548,8 @@ def test_raft_chain_snapshot_catchup_pulls_blocks(cluster, tmp_path):
             return RaftChain(i, ids, transport,
                              str(tmp_path / f"snap_{i}.wal"), support,
                              snapshot_interval=4,
-                             block_fetcher=fetcher_for(i))
+                             block_fetcher=fetcher_for(i),
+                             clock=clock, rng=_seeded_rng(i))
         reg = Registrar(str(tmp_path / ("snap_" + i)), signer, csp,
                         chain_factory=factory)
         reg.create_channel(blk)
@@ -497,8 +560,9 @@ def test_raft_chain_snapshot_catchup_pulls_blocks(cluster, tmp_path):
     supports = world["supports"]
     chains = {i: s.chain for i, s in supports.items()}
     try:
-        assert _wait(lambda: any(c.is_leader for c in chains.values()),
-                     timeout=15.0)
+        assert _advance_until(clock,
+                              lambda: any(c.is_leader
+                                          for c in chains.values()))
         leader_id = next(i for i, c in chains.items() if c.is_leader)
 
         def env(k):
@@ -531,9 +595,13 @@ def test_raft_chain_snapshot_catchup_pulls_blocks(cluster, tmp_path):
             lambda: chains[leader_id]._raft._wal.snap_index > 0,
             timeout=15.0)
         # heal -> snapshot install -> block pull -> identical chains
+        # (driven by the leader's clock-stepped heartbeats; the
+        # snapshot re-offer backoff is 10 heartbeats of fake time)
         transport.partitioned.clear()
-        assert _wait(lambda: supports[victim].store.height ==
-                     supports[leader_id].store.height, timeout=30.0)
+        assert _advance_until(clock,
+                              lambda: supports[victim].store.height ==
+                              supports[leader_id].store.height,
+                              max_steps=400)
         h = supports[leader_id].store.height
         for num in range(1, h):
             hashes = {protoutil.block_header_hash(
@@ -595,6 +663,7 @@ def reconf_cluster(tmp_path):
         {"OrdererOrg": [calib.cert_pem(ord_ca.cert)]},
         consensus_type="etcdraft", batch_timeout="150ms",
         max_message_count=5, consenters=ids)
+    clock = ManualClock()
     transport = RaftTransport()
     registrars = {}
 
@@ -606,7 +675,8 @@ def reconf_cluster(tmp_path):
 
         def factory(support, i=i):
             return RaftChain(i, ids, transport,
-                             str(tmp_path / f"{i}.wal"), support)
+                             str(tmp_path / f"{i}.wal"), support,
+                             clock=clock, rng=_seeded_rng(i))
         reg = Registrar(str(tmp_path / i), signer, csp,
                         chain_factory=factory)
         reg.create_channel(blk)
@@ -617,6 +687,7 @@ def reconf_cluster(tmp_path):
     world = {"csp": csp, "org_ca": org_ca, "ord_ca": ord_ca,
              "ids": ids, "transport": transport, "genesis": blk,
              "registrars": registrars, "tmp": tmp_path, "boot": boot,
+             "clock": clock,
              "supports": {i: registrars[i].get_chain("reconf")
                           for i in ids}}
     yield world
@@ -635,8 +706,11 @@ def test_consenter_removal_via_config(reconf_cluster):
     world = reconf_cluster
     sup = world["supports"]
     chains = {i: s.chain for i, s in sup.items()}
-    assert _wait(lambda: any(c.is_leader for c in chains.values()),
-                 timeout=15.0)
+    # ordering goes through r0, possibly a FOLLOWER: wait until every
+    # node knows the leader, or r0 silently drops the forwarded
+    # submits (clients retry by design) and the commit wait flakes
+    assert _advance_until(world["clock"],
+                          lambda: leader_known_by_all(chains))
     for k in range(4):
         sup["r0"].chain.order(_client_env_for(world, k), 0)
     assert _wait(lambda: all(_all_txs(s) >= 4 for s in sup.values()),
@@ -670,8 +744,9 @@ def test_consenter_addition_via_config(reconf_cluster):
     world = reconf_cluster
     sup = world["supports"]
     chains = {i: s.chain for i, s in sup.items()}
-    assert _wait(lambda: any(c.is_leader for c in chains.values()),
-                 timeout=15.0)
+    assert _advance_until(world["clock"],
+                          lambda: any(c.is_leader
+                                      for c in chains.values()))
     leader_id = next(i for i, c in chains.items() if c.is_leader)
     for k in range(3):
         sup[leader_id].chain.order(_client_env_for(world, k), 0)
@@ -694,17 +769,22 @@ def test_consenter_addition_via_config(reconf_cluster):
 
     def factory(support):
         return RaftChain("r3", new_ids, world["transport"],
-                         str(world["tmp"] / "r3.wal"), support)
+                         str(world["tmp"] / "r3.wal"), support,
+                         clock=world["clock"], rng=_seeded_rng("r3"))
     reg3 = Registrar(str(world["tmp"] / "r3"), signer, world["csp"],
                      chain_factory=factory)
     reg3.create_channel(world["genesis"])
     world["registrars"]["r3"] = reg3
     sup3 = reg3.get_chain("reconf")
     assert not sup3.chain._raft.member     # observer at boot
-    # it catches up through the replicated log and becomes a member
-    assert _wait(lambda: sup3.store.height ==
-                 sup[leader_id].store.height, timeout=25.0)
-    assert _wait(lambda: sup3.chain._raft.member, timeout=10.0)
+    # it catches up through the replicated log (the leader's next
+    # clock-driven append round reaches the new peer) and becomes a
+    # member when the config entry applies
+    assert _advance_until(world["clock"],
+                          lambda: sup3.store.height ==
+                          sup[leader_id].store.height)
+    assert _advance_until(world["clock"],
+                          lambda: sup3.chain._raft.member)
     # and participates: order more, everyone converges
     for k in range(3, 6):
         sup[leader_id].chain.order(_client_env_for(world, k), 0)

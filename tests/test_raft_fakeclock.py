@@ -10,29 +10,11 @@ import time
 
 from fabric_mod_tpu.orderer.raft import RaftNode, RaftTransport
 from fabric_mod_tpu.utils.fakeclock import ManualClock
+from tests._clocksteps import advance_until, settle as _settle
 
 
 def _advance_until(clock, pred, step=0.02, max_steps=80):
-    """Step fake time finely so the EARLIEST pending timer fires alone
-    (coarse jumps would expire every node's timeout in one wave and
-    split the vote — randomized timeouts only help when time moves
-    continuously)."""
-    for _ in range(max_steps):
-        if _settle(pred, timeout=0.2):
-            return True
-        clock.advance(step)
-    return _settle(pred)
-
-
-def _settle(pred, timeout=5.0):
-    """Wait (REAL time) for the FSM threads to process queued work —
-    message passing is still thread-based; only TIMERS are faked."""
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return True
-        time.sleep(0.005)
-    return pred()
+    return advance_until(clock, pred, step=step, max_steps=max_steps)
 
 
 def _cluster(tmp_path, clock, ids=("a", "b", "c"), rngs=None):
