@@ -1,0 +1,216 @@
+"""Device-verifier circuit breaker: degrade to software, heal by probe.
+
+(reference stance: Fabric treats its crypto provider as infallible —
+a bccsp failure fails the request.  A TPU/XLA runtime is NOT
+infallible: device resets, OOMs, and runtime errors are operational
+events, and the sw verifier computes the IDENTICAL verdict function,
+just slower.  So the verify path degrades instead of failing: a
+device error fails over per-batch to software, and after K
+CONSECUTIVE device failures the breaker opens — batches skip the
+device entirely — until a probe dispatch proves it healthy again.
+The breaker shape is the standard one: Nygard, "Release It!", ch. 5.)
+
+States: "closed" (device in use) -> "open" (K consecutive failures;
+everything routes to the sw fallback) -> closed again when a probe
+succeeds.  Probes run two ways:
+
+* a **background prober** thread, started when the circuit opens,
+  retries the probe every `probe_interval_s` (event-driven: tests call
+  `probe_soon()` instead of sleeping) and exits once the circuit
+  closes — traffic never pays the probe's latency;
+* `probe_now()` runs one probe synchronously (deterministic tests,
+  CLI health checks).
+
+Everything is clock-injectable; the recovery-time histogram measures
+open→closed on that clock.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Callable, Optional
+
+from fabric_mod_tpu.concurrency import RegisteredLock, RegisteredThread
+from fabric_mod_tpu.observability.metrics import (MetricOpts,
+                                                  default_provider)
+from fabric_mod_tpu.utils.env import env_float, env_int
+
+_STATE_OPTS = MetricOpts(
+    "fabric", "bccsp", "breaker_state",
+    help="Device-verifier circuit state: 0 closed (device in use), "
+         "1 open (all batches degraded to the sw verifier).",
+    label_names=("name",))
+_OPENS_OPTS = MetricOpts(
+    "fabric", "bccsp", "breaker_opens_total",
+    help="Times the device-verifier circuit opened (K consecutive "
+         "device failures).",
+    label_names=("name",))
+_RECOVERY_OPTS = MetricOpts(
+    "fabric", "bccsp", "breaker_recovery_seconds",
+    help="Open->closed duration per recovery: how long verifies ran "
+         "degraded on the sw fallback before a probe healed the device.")
+
+
+@functools.lru_cache(maxsize=None)
+def _metrics():
+    prov = default_provider()
+    return (prov.gauge(_STATE_OPTS), prov.counter(_OPENS_OPTS),
+            prov.histogram(_RECOVERY_OPTS,
+                           buckets=(0.1, 1, 5, 15, 60, 300, 1800)))
+
+
+def breaker_k(default: int = 3) -> int:
+    """FABRIC_MOD_TPU_BREAKER_K: consecutive device failures that open
+    the circuit; 0 disables the breaker (device errors keep failing
+    over per-batch, but the device is always retried)."""
+    return max(0, env_int("FABRIC_MOD_TPU_BREAKER_K", default))
+
+
+def probe_interval_s(default: float = 5.0) -> float:
+    """FABRIC_MOD_TPU_BREAKER_PROBE_S: background probe period while
+    open; 0 disables the prober thread (probe_now() only)."""
+    return max(0.0, env_float("FABRIC_MOD_TPU_BREAKER_PROBE_S",
+                              default))
+
+
+class CircuitBreaker:
+    """K-consecutive-failure breaker with a background healing probe.
+
+    `probe()` must return True iff the guarded resource is healthy; it
+    runs OFF the request path (prober thread or explicit probe_now).
+    Thread-safe; near-zero cost while closed (one lock + int check).
+    """
+
+    def __init__(self, k: Optional[int] = None,
+                 probe: Optional[Callable[[], bool]] = None,
+                 interval_s: Optional[float] = None,
+                 clock=None, name: str = "device-verify"):
+        self.k = breaker_k() if k is None else max(0, k)
+        self.interval_s = (probe_interval_s() if interval_s is None
+                           else max(0.0, interval_s))
+        self._probe = probe
+        self._clock = clock or time
+        self.name = name
+        self._lock = RegisteredLock(f"breaker[{name}]")
+        self._failures = 0                 # consecutive, while closed
+        self._open = False
+        self._opened_at = 0.0
+        self._stopped = threading.Event()
+        self._wake = threading.Event()     # probe_soon() / stop()
+        self._prober: Optional[threading.Thread] = None
+        g_state, self._m_opens, self._m_recovery = _metrics()
+        self._g_state = g_state.with_labels(name)
+        self._g_state.set(0)
+
+    # -- request-path surface ---------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return "open" if self._open else "closed"
+
+    def allow(self) -> bool:
+        """May the next batch try the device?  (Open ⇒ no: callers go
+        straight to the fallback — no half-open traffic gambling; the
+        probe owns recovery.)"""
+        with self._lock:
+            return not self._open
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+
+    def record_failure(self) -> bool:
+        """One device failure; returns True when this call OPENED the
+        circuit (K consecutive reached, K>0)."""
+        with self._lock:
+            self._failures += 1
+            if self._open or self.k == 0 or self._failures < self.k:
+                return False
+            self._open = True
+            self._opened_at = self._clock.monotonic()
+            # gauge flips INSIDE the critical section: published
+            # outside, a racing probe's set(0) could be overwritten
+            # and report an open circuit that is actually closed
+            self._g_state.set(1)
+        self._m_opens.with_labels(self.name).add(1)
+        self._start_prober()
+        return True
+
+    # -- healing -----------------------------------------------------------
+    def probe_now(self) -> bool:
+        """Run one probe synchronously; closes the circuit on success.
+        Returns the new `allow()` — True when healthy."""
+        with self._lock:
+            if not self._open:
+                return True
+        probe = self._probe
+        healthy = True if probe is None else bool(probe())
+        if healthy:
+            self._close()
+        return healthy
+
+    def probe_soon(self) -> None:
+        """Nudge the background prober to run immediately (tests: the
+        deterministic stand-in for waiting out interval_s)."""
+        self._wake.set()
+
+    def _close(self) -> None:
+        with self._lock:
+            if not self._open:
+                return
+            self._open = False
+            self._failures = 0
+            took = self._clock.monotonic() - self._opened_at
+            self._g_state.set(0)           # same section as the flip
+        self._m_recovery.observe(max(0.0, took))
+
+    def _start_prober(self) -> None:
+        if self._probe is None or self.interval_s <= 0 \
+                or self._stopped.is_set():
+            return
+        with self._lock:
+            # registration (not liveness) gates the spawn: a healed
+            # prober DEREGISTERS under this lock before returning, so
+            # a circuit that re-opens while the old thread is still
+            # physically exiting gets a fresh prober instead of
+            # trusting a thread that already decided to die (which
+            # would leave the circuit open forever with probe_soon()
+            # waking nobody)
+            if self._prober is not None:
+                return
+            self._wake.clear()
+            t = RegisteredThread(target=self._probe_loop,
+                                 name=f"breaker-probe[{self.name}]",
+                                 structure="CircuitBreaker")
+            self._prober = t
+        t.start()
+
+    def _probe_loop(self) -> None:
+        while not self._stopped.is_set():
+            self._wake.wait(timeout=self.interval_s)
+            self._wake.clear()
+            if self._stopped.is_set():
+                return
+            try:
+                self.probe_now()
+            except Exception:
+                pass                       # a raising probe is a failure
+            with self._lock:
+                # exit ONLY while verifiably closed, deregistering in
+                # the same critical section: record_failure's
+                # _start_prober is serialized against this, so either
+                # we see the re-open and keep looping, or it sees the
+                # deregistration and spawns a successor
+                if not self._open:
+                    self._prober = None
+                    return
+
+    def stop(self) -> None:
+        """Tear down the prober (owner teardown / test cleanup)."""
+        self._stopped.set()
+        self._wake.set()
+        with self._lock:
+            t, self._prober = self._prober, None
+        if t is not None:
+            t.join(timeout=10)

@@ -43,22 +43,26 @@ from __future__ import annotations
 
 import collections
 import operator
-import os
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
+from concurrent.futures import TimeoutError as FutureTimeout
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from fabric_mod_tpu import faults
 from fabric_mod_tpu.bccsp.api import BCCSP, Key, VerifyItem
+from fabric_mod_tpu.bccsp.breaker import CircuitBreaker
 from fabric_mod_tpu.bccsp import der as _der
 from fabric_mod_tpu.bccsp import sw as _sw
 from fabric_mod_tpu.concurrency import (GuardedQueue, RegisteredLock,
                                         RegisteredThread, assert_joined)
 from fabric_mod_tpu.observability.metrics import (MetricOpts,
                                                   default_provider)
+from fabric_mod_tpu.utils.env import env_float as _env_float
+from fabric_mod_tpu.utils.env import env_int as _env_int
 
 # Persistent XLA compilation cache: the ECDSA ladder costs tens of
 # seconds to compile; cache it across processes.  (Shared helper —
@@ -256,13 +260,39 @@ class VerdictCache:
 
 
 def _cache_from_env() -> Optional[VerdictCache]:
-    cap = int(os.environ.get("FABRIC_MOD_TPU_VERDICT_CACHE", "8192"))
+    cap = _env_int("FABRIC_MOD_TPU_VERDICT_CACHE", 8192)
     return VerdictCache(cap) if cap > 0 else None
 
 
 # ---------------------------------------------------------------------------
 # The device verifier
 # ---------------------------------------------------------------------------
+
+_DEVICE_ERRORS_OPTS = MetricOpts(
+    "fabric", "bccsp", "device_errors_total",
+    help="Device/XLA runtime errors on the verify path (each failed "
+         "over per-batch to the sw verifier).")
+_FALLBACK_OPTS = MetricOpts(
+    "fabric", "bccsp", "sw_fallback_batches_total",
+    help="Verify batches answered by the sw fallback instead of the "
+         "device (device error, or circuit open).")
+
+
+def is_device_error(e: BaseException) -> bool:
+    """Is `e` a device/XLA-runtime failure (vs. a host-side bug)?
+    Device failures are operational — the sw verifier computes the
+    identical verdict function, so they degrade instead of failing.
+    Host exceptions (marshalling bugs, bad types, and jax's own
+    TRACING errors like ConcretizationTypeError — those are program
+    bugs, not outages) must keep raising: masking them behind the
+    fallback would hide real defects, so only the RUNTIME error
+    classes the XLA client raises for device/executor failures
+    qualify."""
+    if isinstance(e, faults.InjectedFault):
+        return e.kind == "device"
+    name = type(e).__name__
+    return "XlaRuntimeError" in name or "JaxRuntimeError" in name
+
 
 class TpuVerifier:
     """Marshals VerifyItems to the device batch verifier.
@@ -285,7 +315,16 @@ class TpuVerifier:
     """
 
     def __init__(self, mesh=None, cache: Optional[VerdictCache] = None,
-                 cache_size: Optional[int] = None):
+                 cache_size: Optional[int] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 fallback=None):
+        """`breaker`: circuit breaker guarding the device (None builds
+        one from the FABRIC_MOD_TPU_BREAKER_K / _BREAKER_PROBE_S
+        knobs; K=0 still fails over per-batch but never opens).
+        `fallback(items) -> bool mask`: the degraded verifier — default
+        is the sw provider's verify_batch, which enforces the same
+        low-S/encoding rules as the device marshaller, so fallback
+        verdicts are bit-identical to device verdicts."""
         self._mesh = mesh
         self._mesh_size = 1
         if mesh is not None:
@@ -301,6 +340,20 @@ class TpuVerifier:
                            else None)
         else:
             self._cache = _cache_from_env()
+        self._fallback = fallback
+        self._fallback_csp: Optional[_sw.SwCSP] = None
+        self.breaker = breaker if breaker is not None else \
+            CircuitBreaker(probe=self._probe_device)
+        prov = default_provider()
+        self._m_device_errors = prov.counter(_DEVICE_ERRORS_OPTS)
+        self._m_fallback = prov.counter(_FALLBACK_OPTS)
+
+    def close(self) -> None:
+        """Tear down the breaker's background prober (if the circuit
+        ever opened).  Verifiers are otherwise stateless; this exists
+        so owners (BatchingVerifyService, tests) can guarantee no
+        probe thread outlives the device it probes."""
+        self.breaker.stop()
 
     def verify_many(self, items: Sequence[VerifyItem]) -> np.ndarray:
         return self.verify_many_async(items)()
@@ -353,15 +406,43 @@ class TpuVerifier:
         return finish
 
     def _dispatch(self, items: Sequence[VerifyItem]):
-        """Marshal + dispatch unique items (no cache/dedup layer)."""
+        """Marshal + dispatch unique items (no cache/dedup layer).
+        Device/XLA runtime errors — at dispatch OR at resolution —
+        fail over per-batch to the sw fallback (identical verdicts)
+        and feed the circuit breaker; with the circuit open the device
+        is skipped outright until a probe re-closes it."""
         n = len(items)
         if n > BUCKETS[-1]:
             # chunk through the fixed buckets — never mint new shapes
             parts = [self._dispatch(items[i:i + BUCKETS[-1]])
                      for i in range(0, n, BUCKETS[-1])]
             return lambda: np.concatenate([p() for p in parts])
+        breaker = self.breaker
+        if not breaker.allow():
+            self._m_fallback.add(1)
+            return lambda: self._fallback_verify(items)
+        try:
+            resolve = self._device_dispatch(items)
+        except Exception as e:
+            return self._degrade(e, items)
+
+        def finish() -> np.ndarray:
+            try:
+                mask = resolve()
+            except Exception as e:
+                return self._degrade(e, items)()
+            breaker.record_success()
+            return mask
+        return finish
+
+    def _device_dispatch(self, items: Sequence[VerifyItem]):
+        """The raw device path: marshal + one program dispatch; the
+        returned resolver blocks on (and surfaces errors from) the
+        device execution."""
+        n = len(items)
         size = _bucket(n, self._mesh_size)
         d, r, s, qx, qy, pre_ok, msg = marshal_items(items, size)
+        faults.point("bccsp.device.dispatch")
         from fabric_mod_tpu.ops import p256
         if msg is not None:
             # fused hash->verify: raw-message lanes hash on device in
@@ -374,7 +455,47 @@ class TpuVerifier:
         else:
             resolve = p256.batch_verify(d, r, s, qx, qy,
                                         mesh=self._mesh, lazy=True)
-        return lambda: (resolve() & pre_ok)[:n]
+
+        def done() -> np.ndarray:
+            faults.point("bccsp.device.resolve")
+            return (resolve() & pre_ok)[:n]
+        return done
+
+    def _degrade(self, e: BaseException, items: Sequence[VerifyItem]):
+        """Handle a dispatch/resolve failure: device errors fall back
+        to the sw verifier (and count toward opening the circuit);
+        anything else re-raises — it is a host bug, not an outage."""
+        if not is_device_error(e):
+            raise e
+        self._m_device_errors.add(1)
+        self._m_fallback.add(1)
+        self.breaker.record_failure()
+        return lambda: self._fallback_verify(items)
+
+    def _fallback_verify(self, items: Sequence[VerifyItem]) -> np.ndarray:
+        """The degraded path: host software, identical verdicts (the
+        sw provider enforces the same low-S/encoding rules the device
+        marshaller bakes into pre_ok)."""
+        fb = self._fallback
+        if fb is not None:
+            return np.asarray(fb(items), bool)
+        csp = self._fallback_csp
+        if csp is None:
+            csp = self._fallback_csp = _sw.SwCSP()
+        return np.asarray(csp.verify_batch(items), bool)
+
+    def _probe_device(self) -> bool:
+        """Breaker probe: one minimal-bucket dispatch must execute
+        without a device error (its verdict is irrelevant — the probe
+        item is garbage by construction)."""
+        try:
+            faults.point("bccsp.device.probe")
+            probe_item = VerifyItem(b"\x00" * 32, b"\x00" * 8,
+                                    b"\x00" * 64)
+            self._device_dispatch([probe_item])()
+            return True
+        except Exception as e:
+            return not is_device_error(e)
 
 
 class FakeBatchVerifier:
@@ -405,6 +526,52 @@ _SERVICE_BATCH_OPTS = MetricOpts(
 _SERVICE_INFLIGHT_OPTS = MetricOpts(
     "fabric", "bccsp", "verify_inflight_batches",
     help="Device batches dispatched but not yet resolved.")
+_SERVICE_TIMEOUTS_OPTS = MetricOpts(
+    "fabric", "bccsp", "verify_deadline_timeouts_total",
+    help="Verify calls that hit the FABRIC_MOD_TPU_VERIFY_DEADLINE "
+         "before their verdicts resolved.")
+
+
+class VerifyDeadlineExceeded(TimeoutError):
+    """The verify deadline expired before the verdict resolved.
+
+    Typed so callers can tell a DEADLINE (device overloaded / stuck —
+    the caller's timeout policy fired) from a device FAILURE (the
+    batch errored — the breaker/fallback layer's business).  Straggler
+    futures of a timed-out verify_many fail with this same error.
+    """
+
+    def __init__(self, msg: str, deadline_s: Optional[float] = None):
+        super().__init__(msg)
+        self.deadline_s = deadline_s
+
+
+def verify_deadline_s(default: float = 30.0) -> Optional[float]:
+    """FABRIC_MOD_TPU_VERIFY_DEADLINE: whole-call deadline (seconds)
+    shared by BatchingVerifyService.verify/verify_many; 0 or negative
+    = no deadline."""
+    got = _env_float("FABRIC_MOD_TPU_VERIFY_DEADLINE", default)
+    return got if got > 0 else None
+
+
+# callers distinguish "use the knob" (default) from an explicit
+# timeout=None (wait forever)
+_DEADLINE_KNOB = object()
+
+
+def _complete(fut: Future, value=None, exc: Optional[BaseException] = None
+              ) -> None:
+    """Complete a Future that a deadline may have failed first: the
+    straggler path and the resolver race, and the loser must not die
+    on InvalidStateError (killing the resolver thread would hang every
+    later caller)."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(value)
+    except InvalidStateError:
+        pass
 
 
 class BatchingVerifyService:
@@ -431,12 +598,15 @@ class BatchingVerifyService:
     def __init__(self, verifier=None, max_batch: int = 2048,
                  deadline_s: float = 0.002,
                  inflight_depth: Optional[int] = None):
+        # a verifier built HERE is owned here: close() must stop its
+        # breaker prober (a caller-provided verifier may be shared, so
+        # its lifecycle stays the caller's)
+        self._owns_verifier = verifier is None
         self._verifier = verifier or TpuVerifier()
         self.max_batch = max_batch
         self.deadline_s = deadline_s
         if inflight_depth is None:
-            inflight_depth = int(os.environ.get(
-                "FABRIC_MOD_TPU_INFLIGHT", "2"))
+            inflight_depth = _env_int("FABRIC_MOD_TPU_INFLIGHT", 2)
         self.inflight_depth = max(1, inflight_depth)
         # submit queue: many producers (any caller), ONE consumer (the
         # flusher worker); in-flight queue: strict SPSC worker ->
@@ -454,6 +624,7 @@ class BatchingVerifyService:
         self._batch_hist = prov.histogram(
             _SERVICE_BATCH_OPTS, buckets=(1, 8, 64, 256, 512, 1024, 2048))
         self._inflight_gauge = prov.gauge(_SERVICE_INFLIGHT_OPTS)
+        self._timeouts = prov.counter(_SERVICE_TIMEOUTS_OPTS)
         self._resolver = RegisteredThread(target=self._resolve_loop,
                                           name="verify-resolver",
                                           structure="BatchingVerifyService")
@@ -476,14 +647,20 @@ class BatchingVerifyService:
         return fut
 
     def verify_many(self, items: Sequence[VerifyItem],
-                    timeout: Optional[float] = 30):
+                    timeout=_DEADLINE_KNOB):
         """The policy-engine seam (same shape as TpuVerifier): submit
         each item and gather verdicts.  Concurrent callers' items
         coalesce into shared device batches — this is how ingress
         paths (broadcast filters, gossip-storm verifies) ride ONE
         deadline-batched dispatch across many independent requests
         (SURVEY §2.9 'admission control feeding fixed-size batches').
-        `timeout` bounds the WHOLE call, not each item."""
+        `timeout` bounds the WHOLE call, not each item; default is the
+        FABRIC_MOD_TPU_VERIFY_DEADLINE knob (explicit None waits
+        forever).  On expiry every still-pending Future fails with
+        VerifyDeadlineExceeded — typed, so callers can tell a deadline
+        from a device failure — and the call raises it."""
+        if timeout is _DEADLINE_KNOB:
+            timeout = verify_deadline_s()
         futs = [self.submit(it) for it in items]
         deadline = (time.monotonic() + timeout
                     if timeout is not None else None)
@@ -491,11 +668,39 @@ class BatchingVerifyService:
         for f in futs:
             remaining = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
-            out.append(f.result(remaining))
+            try:
+                out.append(f.result(remaining))
+            except FutureTimeout:
+                raise self._fail_stragglers(futs, timeout) from None
         return out
 
-    def verify(self, item: VerifyItem, timeout: Optional[float] = 30) -> bool:
-        return self.submit(item).result(timeout)
+    def _fail_stragglers(self, futs: Sequence[Future],
+                         timeout: Optional[float]
+                         ) -> "VerifyDeadlineExceeded":
+        """Deadline expiry: fail every not-yet-resolved Future with the
+        typed timeout error so no caller is left parked on a verdict
+        the device may never produce.  (A resolver completing a future
+        concurrently wins harmlessly — both sides complete through the
+        InvalidStateError-tolerant `_complete`.)"""
+        pending = [f for f in futs if not f.done()]
+        err = VerifyDeadlineExceeded(
+            f"verify deadline ({timeout}s) expired with "
+            f"{len(pending)} verdict(s) outstanding", deadline_s=timeout)
+        for f in pending:
+            _complete(f, exc=err)
+        self._timeouts.add(1)
+        return err
+
+    def verify(self, item: VerifyItem, timeout=_DEADLINE_KNOB) -> bool:
+        """Single-item verify under the shared deadline knob (see
+        verify_many for the timeout semantics)."""
+        if timeout is _DEADLINE_KNOB:
+            timeout = verify_deadline_s()
+        fut = self.submit(item)
+        try:
+            return fut.result(timeout)
+        except FutureTimeout:
+            raise self._fail_stragglers([fut], timeout) from None
 
     def close(self) -> None:
         """Stop both threads, draining: everything already submitted
@@ -523,8 +728,12 @@ class BatchingVerifyService:
                     _, fut = self._q.get_nowait()
                 except queue.Empty:
                     break
-                fut.set_exception(
-                    RuntimeError("verify service is closed"))
+                _complete(fut, exc=RuntimeError(
+                    "verify service is closed"))
+            if self._owns_verifier:
+                close = getattr(self._verifier, "close", None)
+                if close is not None:
+                    close()
 
     # -- worker side: accumulate + dispatch -------------------------------
 
@@ -543,7 +752,7 @@ class BatchingVerifyService:
                 resolve = lambda: mask               # noqa: E731
         except Exception as e:
             for _, fut in batch:
-                fut.set_exception(e)
+                _complete(fut, exc=e)
             return
         # Bounded in-flight window: blocks when `inflight_depth`
         # batches are already executing — backpressure on the worker.
@@ -592,11 +801,13 @@ class BatchingVerifyService:
             batch, resolve = got
             try:
                 mask = resolve()
+                # _complete, not set_result: a deadline-failed
+                # straggler must not kill the resolver thread
                 for (_, fut), ok in zip(batch, mask):
-                    fut.set_result(bool(ok))
+                    _complete(fut, bool(ok))
             except Exception as e:
                 for _, fut in batch:
-                    fut.set_exception(e)
+                    _complete(fut, exc=e)
             finally:
                 self._inflight_gauge.add(-1)
 

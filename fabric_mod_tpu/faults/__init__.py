@@ -1,0 +1,16 @@
+"""Fault-injection framework: named seams, deterministic triggers.
+
+Armed by ``FMT_FAULTS`` (env) or programmatically; near-zero cost
+unarmed — the seams live in production code permanently, the way the
+FMT_RACECHECK guards do.  See faults/core.py for the grammar and the
+trigger catalog (fire-on-Nth-call / seeded-probability / one-shot).
+"""
+from fabric_mod_tpu.faults.core import (FaultPlan, FaultRule,
+                                        InjectedFault, active, arm,
+                                        armed, current_plan, disarm,
+                                        point)
+
+__all__ = [
+    "InjectedFault", "FaultRule", "FaultPlan",
+    "point", "arm", "disarm", "active", "armed", "current_plan",
+]

@@ -1,0 +1,281 @@
+"""Deterministic, seedable fault injection: the tolerance-proof harness.
+
+(reference evaluation model: Raft's leader-crash validation — Ongaro &
+Ousterhout, ATC '14 §9.2 — crashes are INJECTED at chosen points and
+recovery is asserted, rather than waited for; Fabric's own chaos
+coverage lives in integration tests that kill orderers/peers
+mid-stream.  PR 4 built the *detection* half of robustness
+(FMT_RACECHECK); this package is the *tolerance* half's proof harness:
+every retry/failover/degradation mechanism in the framework lands with
+the injected fault that kills the old code and the test that shows the
+new code survives it.)
+
+Usage — production code declares **named injection points** at its
+fault seams::
+
+    from fabric_mod_tpu import faults
+    ...
+    faults.point("gossip.comm.send")       # raises InjectedFault when
+                                           # an armed rule triggers
+
+    if faults.point("gossip.comm.drop"):   # drop-mode rules return
+        return False                       # True instead of raising
+
+Unarmed (the default), ``point()`` is one module-attribute read and a
+``None`` check — the FMT_RACECHECK cost model, so the seams stay in
+production code permanently.
+
+Plans are armed programmatically (tests)::
+
+    plan = faults.FaultPlan().add("deliver.stream", nth=3)
+    with faults.active(plan):
+        ...                                # 3rd pass through the point
+                                           # raises InjectedFault
+
+or by environment for whole-process chaos runs::
+
+    FMT_FAULTS="deliver.stream:error@n=3;gossip.comm.send:drop@p=0.2,seed=7"
+
+Triggers are **deterministic**: fire-on-Nth-call (``n=K``, 1-based —
+fires from the Kth pass on, so ``times`` caps apply), one-shot
+(``once`` ≡ ``n=1``), or seeded probability (``p=F,seed=S`` — a
+per-rule ``random.Random(S)``, so a given seed yields the same fire
+pattern on every run).  ``times=T`` caps total fires (default 1 for
+``n``/``once``, unlimited for ``p``).  ``kind=K`` labels the raised
+fault's failure class — ``kind=device`` is what the bccsp circuit
+breaker classifies as a device/XLA error.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import random
+import threading
+from typing import Dict, List, Optional
+
+from fabric_mod_tpu.observability.metrics import (MetricOpts,
+                                                  default_provider)
+
+_FIRED_OPTS = MetricOpts(
+    "fabric", "faults", "injected_total",
+    help="Faults fired by the injection framework, per point (nonzero "
+         "outside chaos runs means FMT_FAULTS leaked into production).",
+    label_names=("point",))
+
+
+@functools.lru_cache(maxsize=None)
+def _fired_counter():
+    return default_provider().counter(_FIRED_OPTS)
+
+
+class InjectedFault(Exception):
+    """Raised at an armed injection point.
+
+    `kind` labels the simulated failure class so classifiers route it
+    like the real thing ("device" → the bccsp breaker's device-error
+    classifier; "io" → transport retry paths; default "fault").
+    """
+
+    def __init__(self, point: str, kind: str = "fault"):
+        super().__init__(f"injected fault at {point!r} (kind={kind})")
+        self.point = point
+        self.kind = kind
+
+
+class FaultRule:
+    """One armed rule: trigger (nth/probability) + action (error/drop)."""
+
+    __slots__ = ("point", "mode", "kind", "nth", "p", "times", "exc",
+                 "_rng", "calls", "fires")
+
+    def __init__(self, point: str, mode: str = "error",
+                 kind: str = "fault", nth: Optional[int] = None,
+                 p: Optional[float] = None, seed: int = 0,
+                 times: Optional[int] = None, exc=None):
+        if mode not in ("error", "drop"):
+            raise ValueError(f"unknown fault mode {mode!r}")
+        if (nth is None) == (p is None):
+            raise ValueError("exactly one trigger: nth=K or p=F")
+        if nth is not None and nth < 1:
+            raise ValueError("nth is 1-based")
+        if p is not None and not (0.0 <= p <= 1.0):
+            raise ValueError("p must be in [0, 1]")
+        self.point = point
+        self.mode = mode
+        self.kind = kind
+        self.nth = nth
+        self.p = p
+        # nth-triggers default to one-shot; probability rules keep
+        # firing (their determinism lives in the seeded rng stream)
+        self.times = times if times is not None else \
+            (1 if nth is not None else None)
+        self.exc = exc                     # optional custom factory
+        self._rng = random.Random(seed)
+        self.calls = 0                     # passes through the point
+        self.fires = 0                     # times this rule triggered
+
+    def evaluate(self) -> bool:
+        """One pass through the point: did this rule trigger?  Caller
+        holds the plan lock (counters + rng stream are shared state)."""
+        self.calls += 1
+        if self.times is not None and self.fires >= self.times:
+            return False
+        if self.nth is not None:
+            # fires FROM the Nth pass on, capped by `times` (default
+            # 1, i.e. exactly the Nth call) — equality would make
+            # `n=K,times=T>1` silently under-inject T-1 faults
+            hit = self.calls >= self.nth
+        else:
+            hit = self._rng.random() < self.p
+        if hit:
+            self.fires += 1
+        return hit
+
+    def make_exception(self) -> Exception:
+        if self.exc is not None:
+            return self.exc() if callable(self.exc) else self.exc
+        return InjectedFault(self.point, self.kind)
+
+
+class FaultPlan:
+    """A set of rules keyed by injection point; armable as a unit."""
+
+    def __init__(self):
+        self._rules: Dict[str, List[FaultRule]] = {}
+        self._lock = threading.Lock()
+
+    def add(self, point: str, mode: str = "error", kind: str = "fault",
+            nth: Optional[int] = None, p: Optional[float] = None,
+            seed: int = 0, times: Optional[int] = None,
+            exc=None) -> "FaultPlan":
+        """Add one rule; returns self for chaining.  Default trigger
+        (neither nth nor p given) is ``nth=1`` — one-shot on first
+        pass, the most common test shape."""
+        if nth is None and p is None:
+            nth = 1
+        rule = FaultRule(point, mode=mode, kind=kind, nth=nth, p=p,
+                         seed=seed, times=times, exc=exc)
+        with self._lock:
+            self._rules.setdefault(point, []).append(rule)
+        return self
+
+    def evaluate(self, point: str) -> Optional[FaultRule]:
+        """The armed-path hit test: first triggering rule, or None."""
+        with self._lock:
+            rules = self._rules.get(point)
+            if not rules:
+                return None
+            for rule in rules:
+                if rule.evaluate():
+                    return rule
+        return None
+
+    def fires(self, point: Optional[str] = None) -> int:
+        """Total fires (per point, or across the plan) — tests assert
+        the fault actually fired, so a renamed/removed seam fails the
+        scenario instead of silently passing it."""
+        with self._lock:
+            rules = (self._rules.get(point, []) if point is not None
+                     else [r for rs in self._rules.values() for r in rs])
+            return sum(r.fires for r in rules)
+
+    def calls(self, point: str) -> int:
+        with self._lock:
+            return sum(r.calls for r in self._rules.get(point, []))
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        """Parse the FMT_FAULTS grammar:
+        ``point:mode@trigger[,opt...][;rule...]`` where trigger is
+        ``n=K`` | ``once`` | ``p=F`` and opts are ``seed=S``,
+        ``times=T``, ``kind=K``.  Malformed rules raise — a chaos run
+        with a typo'd plan must fail loudly, not run clean."""
+        plan = cls()
+        for raw in spec.split(";"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                head, _, trig = raw.partition("@")
+                point, _, mode = head.partition(":")
+                kw: dict = {"mode": mode or "error"}
+                for part in (trig or "once").split(","):
+                    part = part.strip()
+                    if part == "once":
+                        kw["nth"] = 1
+                    elif part.startswith("n="):
+                        kw["nth"] = int(part[2:])
+                    elif part.startswith("p="):
+                        kw["p"] = float(part[2:])
+                    elif part.startswith("seed="):
+                        kw["seed"] = int(part[5:])
+                    elif part.startswith("times="):
+                        kw["times"] = int(part[6:])
+                    elif part.startswith("kind="):
+                        kw["kind"] = part[5:]
+                    else:
+                        raise ValueError(f"unknown option {part!r}")
+                plan.add(point.strip(), **kw)
+            except Exception as e:
+                raise ValueError(
+                    f"bad FMT_FAULTS rule {raw!r}: {e}") from e
+        return plan
+
+
+# -- the module-level arming gate (mirrors concurrency.core) ---------------
+
+_plan: Optional[FaultPlan] = None
+
+
+def armed() -> bool:
+    return _plan is not None
+
+
+def current_plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def arm(plan: FaultPlan) -> None:
+    """Arm a plan process-wide (production chaos uses FMT_FAULTS)."""
+    global _plan
+    _plan = plan
+
+
+def disarm() -> None:
+    global _plan
+    _plan = None
+
+
+@contextlib.contextmanager
+def active(plan: FaultPlan):
+    """Scoped arming — the fault-scenario tests' toggle."""
+    global _plan
+    prev = _plan
+    _plan = plan
+    try:
+        yield plan
+    finally:
+        _plan = prev
+
+
+def point(name: str) -> bool:
+    """The injection seam.  Unarmed: one None-check, returns False.
+    Armed: if a rule for `name` triggers, raise its exception
+    (mode="error") or return True (mode="drop" — the caller drops the
+    unit of work it was about to process)."""
+    plan = _plan
+    if plan is None:
+        return False
+    rule = plan.evaluate(name)
+    if rule is None:
+        return False
+    _fired_counter().with_labels(name).add(1)
+    if rule.mode == "error":
+        raise rule.make_exception()
+    return True
+
+
+_env_spec = os.environ.get("FMT_FAULTS", "")
+if _env_spec:
+    arm(FaultPlan.from_spec(_env_spec))

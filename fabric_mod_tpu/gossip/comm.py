@@ -16,9 +16,12 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional
 
+from fabric_mod_tpu import faults
 from fabric_mod_tpu.concurrency import (GuardedQueue, RegisteredLock,
                                         RegisteredThread, assert_joined)
 from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.utils.env import env_int
+from fabric_mod_tpu.utils.retry import Retrier
 
 Handler = Callable[[bytes, bytes], None]     # (src_pki_id, envelope bytes)
 
@@ -121,7 +124,9 @@ class GRPCGossipNetwork:
                  client_cert: Optional[bytes] = None,
                  client_key: Optional[bytes] = None,
                  send_timeout_s: float = 1.5,
-                 auth: Optional[GossipAuth] = None):
+                 auth: Optional[GossipAuth] = None,
+                 send_retries: Optional[int] = None,
+                 retrier: Optional[Retrier] = None):
         """With `auth`, every connection must complete the signed
         handshake before Message RPCs are accepted: the remote signs
         (context ‖ server nonce ‖ its TLS client-cert digest), the
@@ -140,6 +145,26 @@ class GRPCGossipNetwork:
         self._client_tls = (client_ca, client_cert, client_key)
         self._timeout = send_timeout_s
         self._auth = auth
+        # per-message send retries: a TRANSIENT peer failure (restart,
+        # one dropped RPC) should cost a short retry, not the message
+        # (gossip tolerates loss, but every loss is convergence delay
+        # anti-entropy must repair later).  A peer that stays dead
+        # still drops its own traffic after the budget — never
+        # blocking other destinations (per-destination queues).
+        # FABRIC_MOD_TPU_GOSSIP_SEND_RETRIES, default 2; 0 restores
+        # the old drop-on-first-failure behavior.
+        if send_retries is None:
+            send_retries = env_int(
+                "FABRIC_MOD_TPU_GOSSIP_SEND_RETRIES", 2)
+        self._send_retries = max(0, send_retries)
+        self._retrier = retrier if retrier is not None else Retrier(
+            base_s=0.05, max_s=min(1.0, send_timeout_s),
+            max_attempts=self._send_retries + 1,
+            giveup=lambda: self._stopped.is_set(),
+            name="gossip.send")
+        # retry budget callers can reason about (stop() join budget)
+        self._retry_sleep_budget = self._retrier.worst_case_delay(
+            self._send_retries)
         self._my_tls_hash = (_pem_cert_der_hash(client_cert)
                              if client_cert is not None else b"")
         # registry-fed mutex: the comm lock nests inside callers'
@@ -189,11 +214,16 @@ class GRPCGossipNetwork:
         # mid-send against an unresponsive peer can legitimately chain
         # handshake hello + auth + send + NACK token-drop + re-
         # handshake + resend (up to ~6 unary calls, each bounded by
-        # send_timeout_s) before re-checking _stopped — derive the
-        # budget from the knob so clean teardown never raises a false
-        # leak at any configured timeout
+        # send_timeout_s) per ATTEMPT, and the retrier may take
+        # send_retries further attempts with backoff sleeps between
+        # (giveup cuts retries once _stopped is set, but a sleep/
+        # attempt already underway completes) — derive the budget
+        # from the knobs so clean teardown never raises a false leak
+        # at any configured timeout
+        worst = (6 * self._timeout * (self._send_retries + 1)
+                 + self._retry_sleep_budget)
         assert_joined(senders, owner="gossip.comm",
-                      timeout=max(15.0, 6 * self._timeout + 1.0))
+                      timeout=max(15.0, worst + 1.0))
 
     # -- the network surface ---------------------------------------------
     def register(self, endpoint: str, handler: Handler) -> None:
@@ -258,19 +288,36 @@ class GRPCGossipNetwork:
             if payload is None or self._stopped.is_set():
                 return
             try:
-                resp = self._send_one(endpoint, payload)
-                if resp == b"NACK" and self._auth is not None:
-                    # receiver restarted and lost our session: drop
-                    # the cached token, re-handshake, retry once
-                    with self._lock:
-                        self._tokens.pop(endpoint, None)
-                    self._send_one(endpoint, payload)
+                # bounded jittered-backoff retries (utils/retry.py):
+                # a transient failure costs a short retry instead of
+                # the message; _attempt_send resets the dead client
+                # between attempts so each retry redials
+                self._retrier.call(self._attempt_send, endpoint,
+                                   payload)
             except Exception:
+                pass          # budget exhausted: drop (gossip re-sends)
+
+    def _attempt_send(self, endpoint: str, payload: bytes) -> bytes:
+        """One send attempt, NACK re-handshake included; on failure
+        the cached client/token are dropped so the NEXT attempt (or
+        message) dials fresh instead of reusing a dead connection."""
+        try:
+            faults.point("gossip.comm.send")
+            resp = self._send_one(endpoint, payload)
+            if resp == b"NACK" and self._auth is not None:
+                # receiver restarted and lost our session: drop the
+                # cached token, re-handshake, retry once
                 with self._lock:
-                    client = self._clients.pop(endpoint, None)
                     self._tokens.pop(endpoint, None)
-                if client is not None:
-                    client.close()
+                resp = self._send_one(endpoint, payload)
+            return resp
+        except Exception:
+            with self._lock:
+                client = self._clients.pop(endpoint, None)
+                self._tokens.pop(endpoint, None)
+            if client is not None:
+                client.close()
+            raise
 
     def _send_one(self, endpoint: str, payload: bytes) -> bytes:
         if self._auth is not None:

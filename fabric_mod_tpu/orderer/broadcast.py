@@ -7,13 +7,28 @@ msgprocessor → WaitReady → Order/Configure.)
 In-process this round: `Broadcast.submit` is the unary equivalent of
 one stream message; the gRPC server wraps this same object when the
 comm layer lands (SURVEY §5.8 keeps gRPC as the control plane).
+
+Robustness: a consenter that momentarily has NO leader (raft election
+in flight, leader just crashed) raises the typed, retryable
+NotLeaderError instead of silently dropping the envelope.  submit()
+retries it on a jittered-backoff schedule bounded by the
+FABRIC_MOD_TPU_BROADCAST_RETRY_S deadline — a leader crash costs one
+election of latency, not a lost transaction — and re-raises it typed
+when the window outlasts the budget, carrying the best leader hint so
+the transport layer can answer SERVICE_UNAVAILABLE + redirect
+(reference: etcdraft's ErrNoLeader → Status SERVICE_UNAVAILABLE).
 """
 from __future__ import annotations
 
+from typing import Optional
+
 from fabric_mod_tpu.channelconfig import ConfigTxError
+from fabric_mod_tpu.orderer.consensus import NotLeaderError
 from fabric_mod_tpu.orderer.msgprocessor import MsgRejectedError
 from fabric_mod_tpu.orderer.registrar import Registrar
 from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.utils.env import env_float
+from fabric_mod_tpu.utils.retry import Retrier
 
 # client-attributable rejections -> BAD_REQUEST on the wire; anything
 # else propagates as an internal error (the gRPC handler maps it to
@@ -21,17 +36,40 @@ from fabric_mod_tpu.protos import messages as m
 _CLIENT_FAULTS = (MsgRejectedError, ConfigTxError, ValueError)
 
 
+def broadcast_retry_s(default: float = 5.0) -> float:
+    """FABRIC_MOD_TPU_BROADCAST_RETRY_S: how long submit() retries a
+    leaderless consenter before surfacing NotLeaderError; 0 disables
+    (every NotLeaderError is immediate — the pre-retry behavior)."""
+    return max(0.0, env_float("FABRIC_MOD_TPU_BROADCAST_RETRY_S",
+                              default))
+
+
 class BroadcastError(Exception):
     pass
 
 
 class Broadcast:
-    def __init__(self, registrar: Registrar):
+    def __init__(self, registrar: Registrar,
+                 retrier: Optional[Retrier] = None):
+        """`retrier` overrides the NOT_LEADER retry policy (tests pass
+        one whose sleep drives a ManualClock); default: jittered
+        backoff under the FABRIC_MOD_TPU_BROADCAST_RETRY_S deadline."""
         self._registrar = registrar
+        if retrier is None:
+            deadline = broadcast_retry_s()
+            retrier = Retrier(
+                base_s=0.05, max_s=0.5,
+                deadline_s=deadline if deadline > 0 else None,
+                max_attempts=1 if deadline <= 0 else None,
+                retry_on=(NotLeaderError,), name="broadcast")
+        self._retrier = retrier
 
     def submit(self, env: m.Envelope) -> None:
         """Accept one envelope for ordering; raises BroadcastError on
-        client-caused rejection (maps to BAD_REQUEST on the wire)."""
+        client-caused rejection (maps to BAD_REQUEST on the wire) and
+        NotLeaderError — after the retry budget — when the ordering
+        service has no leader (maps to SERVICE_UNAVAILABLE: the
+        client's cue to back off or follow the leader hint)."""
         try:
             support, is_config_update = \
                 self._registrar.broadcast_channel_support(env)
@@ -43,7 +81,10 @@ class Broadcast:
                     support.processor.process_config_update_msg(env)
                 # consenters' pre-order checks (e.g. the raft
                 # one-membership-change rule) are client faults too
-                support.chain.configure(wrapped, seq)
+                self._retrier.call(
+                    support.chain.configure, wrapped, seq)
+            except NotLeaderError:
+                raise
             except _CLIENT_FAULTS as e:
                 raise BroadcastError(f"config update rejected: {e}") from e
         else:
@@ -51,4 +92,4 @@ class Broadcast:
                 seq = support.processor.process_normal_msg(env)
             except _CLIENT_FAULTS as e:
                 raise BroadcastError(f"rejected: {e}") from e
-            support.chain.order(env, seq)
+            self._retrier.call(support.chain.order, env, seq)

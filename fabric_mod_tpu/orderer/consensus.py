@@ -24,6 +24,23 @@ class ChainHaltedError(Exception):
     pass
 
 
+class NotLeaderError(Exception):
+    """This consenter cannot accept the submission right now: it is
+    not the leader and has no live leader to forward to (a leaderless
+    election window, or a deposed leader mid-step-down).
+
+    `leader_hint` is the consenter id of the best-known leader (None
+    when unknown) — the reference's Submit redirect carries the same
+    hint (orderer/common/cluster: SubmitResponse.Info).  Retryable by
+    construction: Broadcast.submit retries it on a backoff schedule,
+    and the gRPC surface maps it to SERVICE_UNAVAILABLE so remote
+    clients do the same."""
+
+    def __init__(self, msg: str, leader_hint=None):
+        super().__init__(msg)
+        self.leader_hint = leader_hint
+
+
 class _Msg:
     __slots__ = ("env", "is_config", "config_seq")
 

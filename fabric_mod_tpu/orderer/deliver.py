@@ -14,6 +14,7 @@ import threading
 import time
 from typing import Iterator, Optional
 
+from fabric_mod_tpu import faults
 from fabric_mod_tpu.orderer.registrar import ChainSupport
 from fabric_mod_tpu.protos import messages as m
 
@@ -34,6 +35,11 @@ class DeliverService:
         while stop is None or num <= stop:
             if stop_event is not None and stop_event.is_set():
                 return
+            # chaos seam: a stream that dies mid-pull (the raised
+            # fault reaches the consumer exactly like a transport
+            # error would — DeliverClient types it as
+            # DeliverDisconnected with the resume height)
+            faults.point("deliver.stream")
             blk = store.get_block_by_number(num)
             if blk is not None:
                 yield blk

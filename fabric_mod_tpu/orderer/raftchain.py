@@ -20,7 +20,8 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
-from fabric_mod_tpu.orderer.consensus import ChainHaltedError
+from fabric_mod_tpu import faults
+from fabric_mod_tpu.orderer.consensus import ChainHaltedError, NotLeaderError
 from fabric_mod_tpu.orderer.raft import RaftNode, RaftTransport
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
@@ -134,13 +135,35 @@ class RaftChain:
         return self._raft.leader_id
 
     def order(self, env: m.Envelope, config_seq: int) -> None:
-        self.wait_ready()
+        self._admission_check()
         self._q.put(_Submit(env.encode(), False, config_seq))
 
     def configure(self, env: m.Envelope, config_seq: int) -> None:
-        self.wait_ready()
+        self._admission_check()
         self._check_membership_change(env)
         self._q.put(_Submit(env.encode(), True, config_seq))
+
+    def _admission_check(self) -> None:
+        """Reject a submission this node can neither order nor forward
+        with a TYPED, retryable error.  The old path enqueued during a
+        leaderless window and the run loop silently dropped the
+        envelope — an invisible loss the client could only discover by
+        timing out on commit.  A follower with a live leader still
+        accepts and forwards (reference: Submit :494); only the
+        leaderless window (election in flight, or a deposed leader
+        still listed as its own leader) rejects, carrying the best
+        leader hint for the retry (reference: etcdraft's
+        ErrNoLeader/SubmitResponse redirect)."""
+        self.wait_ready()
+        faults.point("orderer.raft.submit")
+        if self.is_leader:
+            return
+        lead = self._raft.leader_id
+        if lead is None or lead == self.node_id:
+            raise NotLeaderError(
+                f"consenter {self.node_id!r} has no raft leader to "
+                f"forward to (election in progress)",
+                leader_hint=None)
 
     def _check_membership_change(self, env: m.Envelope) -> None:
         """Reject consenter-set changes touching more than ONE member:
@@ -197,10 +220,19 @@ class RaftChain:
                 except queue.Full:
                     break                  # backpressure: clients retry
 
+    _PARKED_CAP = 10_000                   # mirrors the ingress queue
+
     def _run(self) -> None:
         support = self._support
         timer_deadline: Optional[float] = None
         was_leader = False
+        # submits ADMITTED (admission saw a live leader) but caught by
+        # a leaderless window before dispatch: parked, not dropped —
+        # the caller already got a successful return, so nobody would
+        # retry a silent drop.  Flushed back through the queue the
+        # moment a route (us as leader, or a known remote leader)
+        # exists; bounded like the ingress queue.
+        parked: List[_Submit] = []
         while not self._halted.is_set():
             timeout = 0.05
             if timer_deadline is not None:
@@ -212,6 +244,18 @@ class RaftChain:
                 sub = "tick"
             if sub is None:
                 break
+            lead = self._raft.leader_id
+            if parked and (self.is_leader or
+                           (lead is not None and lead != self.node_id)):
+                # a route exists again: re-inject parked submits for
+                # normal processing (leader path orders them, the
+                # follower path forwards them)
+                while parked:
+                    try:
+                        self._q.put_nowait(parked[0])
+                    except queue.Full:
+                        break              # keep the rest parked
+                    parked.pop(0)
             if not self.is_leader:
                 if was_leader:
                     # leadership lost: discard the pending batch —
@@ -222,12 +266,13 @@ class RaftChain:
                 timer_deadline = None
                 # followers forward; never to ourselves (a deposed
                 # leader still listed as leader would spin-loop)
-                lead = self._raft.leader_id
-                if isinstance(sub, _Submit) and lead is not None and \
-                        lead != self.node_id:
-                    self._transport.send(
-                        f"{self.node_id}:chain", f"{lead}:chain", sub)
-                # leader-less: requeue nothing; clients retry
+                if isinstance(sub, _Submit):
+                    if lead is not None and lead != self.node_id:
+                        self._transport.send(
+                            f"{self.node_id}:chain", f"{lead}:chain",
+                            sub)
+                    elif len(parked) < self._PARKED_CAP:
+                        parked.append(sub)  # leaderless: hold, don't drop
                 continue
             was_leader = True
             # -- leader path --

@@ -16,6 +16,7 @@ from typing import Iterator, Optional
 
 from fabric_mod_tpu.comm.grpc_comm import GRPCServer, MethodKind
 from fabric_mod_tpu.orderer.broadcast import Broadcast, BroadcastError
+from fabric_mod_tpu.orderer.consensus import NotLeaderError
 from fabric_mod_tpu.orderer.deliver import DeliverService
 from fabric_mod_tpu.orderer.registrar import Registrar
 from fabric_mod_tpu.protos import messages as m
@@ -59,6 +60,15 @@ class OrdererServer:
             except BroadcastError as e:
                 resp = m.BroadcastResponse(
                     status=m.Status.BAD_REQUEST, info=str(e))
+            except NotLeaderError as e:
+                # leaderless past the retry budget: retryable, with
+                # the best leader hint (reference: etcdraft Submit ->
+                # SERVICE_UNAVAILABLE + redirect info)
+                hint = (f"; try {e.leader_hint}"
+                        if e.leader_hint else "")
+                resp = m.BroadcastResponse(
+                    status=m.Status.SERVICE_UNAVAILABLE,
+                    info=f"no leader: retry{hint}")
             except Exception as e:
                 resp = m.BroadcastResponse(
                     status=m.Status.INTERNAL_SERVER_ERROR, info=str(e))

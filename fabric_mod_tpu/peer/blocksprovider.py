@@ -27,10 +27,12 @@ import threading
 import time
 from typing import Iterator, List, Optional, Sequence
 
+from fabric_mod_tpu import faults
 from fabric_mod_tpu.comm.grpc_comm import GRPCClient
 from fabric_mod_tpu.observability import get_logger
 from fabric_mod_tpu.orderer.server import SERVICE, make_seek_envelope
 from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.utils.retry import Retrier
 
 log = get_logger("peer.blocksprovider")
 
@@ -69,13 +71,19 @@ class FailoverDeliverSource:
     """Multi-orderer deliver stream with rotation + backoff."""
 
     def __init__(self, endpoints: Sequence[Endpoint], channel_id: str,
-                 base_backoff_s: float = 0.1, max_backoff_s: float = 10.0):
+                 base_backoff_s: float = 0.1, max_backoff_s: float = 10.0,
+                 retrier: Optional[Retrier] = None):
+        """`retrier` owns the between-full-rotations backoff schedule
+        (jittered exponential, utils/retry.py); pass a seeded one for
+        a deterministic schedule — default derives from
+        base_backoff_s/max_backoff_s."""
         if not endpoints:
             raise ValueError("at least one orderer endpoint required")
         self._endpoints: List[Endpoint] = list(endpoints)
         self._channel_id = channel_id
-        self._base = base_backoff_s
-        self._max = max_backoff_s
+        self._retrier = retrier if retrier is not None else Retrier(
+            base_s=base_backoff_s, max_s=max_backoff_s,
+            name="deliver.failover")
         self._idx = 0                      # current endpoint
         self._resume: Optional[int] = None  # set by report_bad_block
         self._lock = threading.Lock()
@@ -129,6 +137,9 @@ class FailoverDeliverSource:
                     watchdog = _StreamWatchdog(stream, timeout_s,
                                                stop_event)
                     for raw in watchdog.iterate():
+                        # chaos seam: a mid-stream death of THIS
+                        # endpoint (the except below rotates away)
+                        faults.point("deliver.failover.stream")
                         resp = m.DeliverResponse.decode(raw)
                         if resp.block is None:
                             break          # terminal status
@@ -179,12 +190,13 @@ class FailoverDeliverSource:
             if not made_progress:
                 consecutive_failures += 1
                 if consecutive_failures >= len(self._endpoints):
-                    # full rotation without progress: back off
-                    # (exponent clamped — a multi-hour outage must not
-                    # overflow the float and kill the deliver thread)
-                    exp = min(30, consecutive_failures
-                              - len(self._endpoints))
-                    delay = min(self._max, self._base * (2 ** exp))
+                    # full rotation without progress: back off on the
+                    # shared jittered-exponential schedule (the
+                    # Retrier clamps the exponent, so a multi-hour
+                    # outage cannot overflow the float and kill the
+                    # deliver thread)
+                    delay = self._retrier.delay_for(
+                        consecutive_failures - len(self._endpoints))
                     if stop_event is not None:
                         if stop_event.wait(delay):
                             return

@@ -19,6 +19,8 @@ from typing import Callable, List, Optional
 from fabric_mod_tpu.channelconfig import (
     Bundle, ConfigTxError, extract_config_update, propose_config_update)
 from fabric_mod_tpu.channelconfig.configtx import config_from_block
+from fabric_mod_tpu.observability.metrics import (MetricOpts,
+                                                  default_provider)
 from fabric_mod_tpu.peer.mcs import MessageCryptoService
 from fabric_mod_tpu.peer.txvalidator import (
     Committer, TxValidator, ValidationInfoProvider)
@@ -29,6 +31,11 @@ from fabric_mod_tpu.protos import protoutil
 # Default endorsement policy reference when the namespace has none
 # (reference: lifecycle's default /Channel/Application/Endorsement)
 DEFAULT_ENDORSEMENT_REF = "/Channel/Application/Endorsement"
+
+_REBUILD_OPTS = MetricOpts(
+    "fabric", "commitpipe", "rebuilds_total",
+    help="Poisoned commit pipelines discarded and rebuilt from the "
+         "committed height (one bad block never bricks the channel).")
 
 
 class Channel:
@@ -242,6 +249,11 @@ class Channel:
                 old, self._commit_pipe = self._commit_pipe, None
             if old is not None:
                 old.close()                # join until the engine died
+                # crash-resume observability: a discarded poisoned
+                # engine is the channel's recovery event — a nonzero
+                # rate here is the ops signal that blocks are failing
+                # and being re-driven through fresh pipes
+                default_provider().counter(_REBUILD_OPTS).add(1)
             from fabric_mod_tpu.peer.commitpipe import PipelinedCommitter
             pipe = PipelinedCommitter(self, depth=depth,
                                       consumer="channel")

@@ -52,6 +52,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from fabric_mod_tpu import faults
 from fabric_mod_tpu.concurrency import (GuardedQueue, OwnedState,
                                         RegisteredLock,
                                         RegisteredThread, assert_joined)
@@ -368,6 +369,11 @@ class PipelinedCommitter:
                     self._inflight += 1
                     self._m_occupancy.set(self._inflight)
                 t0 = time.perf_counter()
+                # chaos seam: an engine crash while staging (the
+                # sticky-error drain below is the recovery contract
+                # under test — a poisoned pipe must fail its callers
+                # and be rebuildable from the committed height)
+                faults.point("commitpipe.stage")
                 staged = self._channel.stage_block(block)
                 dt = time.perf_counter() - t0
                 self._stage_state.secs += dt
@@ -392,6 +398,12 @@ class PipelinedCommitter:
             if staged is None:
                 return
             try:
+                # chaos seam: a crash between verdict await and ledger
+                # write — the worst spot: the block is staged, its
+                # device batch resolved, and NOTHING may have reached
+                # the ledger (crash-resume must re-commit it exactly
+                # once from the durable height)
+                faults.point("commitpipe.commit")
                 t0 = time.perf_counter()
                 staged.resolve_mask()      # the device-verdict wait
                 dt = time.perf_counter() - t0

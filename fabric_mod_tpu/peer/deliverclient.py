@@ -41,6 +41,22 @@ from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
 
 
+class DeliverDisconnected(Exception):
+    """The deliver stream died mid-pull (source raised) in a
+    single-endpoint (non-failover) configuration.
+
+    Typed, and carries `height` — the last committed ledger height —
+    so a supervisor can resume a fresh client from exactly the next
+    needed block instead of parsing a bare transport exception.  A
+    FailoverDeliverSource never surfaces this: it rotates to another
+    orderer internally (reference: blocksprovider.go:141/:227 — the
+    retry path this error marks the absence of)."""
+
+    def __init__(self, msg: str, height: Optional[int] = None):
+        super().__init__(msg)
+        self.height = height
+
+
 class DeliverClient:
     """Pulls blocks from a deliver source into a channel's commit path.
 
@@ -151,10 +167,26 @@ class DeliverClient:
         if start > 0:
             prev = self._channel.ledger.get_block_by_number(start - 1)
             prev_hash = protoutil.block_header_hash(prev.header)
+        dropped: Optional[BaseException] = None
         try:
-            for block in self._source.blocks(
-                    start, stop=stop_at, stop_event=self._stop,
-                    timeout_s=idle_timeout_s):
+            source_iter = iter(self._source.blocks(
+                start, stop=stop_at, stop_event=self._stop,
+                timeout_s=idle_timeout_s))
+            while True:
+                try:
+                    block = next(source_iter)
+                except StopIteration:
+                    break                  # clean end / idle timeout
+                except Exception as e:
+                    # dropped stream, single-endpoint mode: surface a
+                    # TYPED error with the resume point, not a bare
+                    # transport exception (a failover source handles
+                    # this internally and never raises here).  Raised
+                    # AFTER the finally drains the pipe, so the
+                    # carried height includes every in-flight commit —
+                    # it IS the next run()'s re-seek point.
+                    dropped = e
+                    break
                 if self._stop.is_set():
                     break
                 try:
@@ -188,6 +220,15 @@ class DeliverClient:
             # returns with commits silently in flight, however long
             # the tail block's cold XLA compile takes
             self._pipe.close()
+        if dropped is not None:
+            height = self._channel.ledger.height
+            if isinstance(dropped, DeliverDisconnected):
+                if dropped.height is None:
+                    dropped.height = height
+                raise dropped
+            raise DeliverDisconnected(
+                f"deliver stream dropped at height {height}: "
+                f"{dropped!r}", height=height) from dropped
 
     def stop(self) -> None:
         self._stop.set()

@@ -26,6 +26,7 @@ class GrpcDeliverSource:
     def blocks(self, start: int = 0, stop: Optional[int] = None,
                stop_event: Optional[threading.Event] = None,
                timeout_s: float = 30.0) -> Iterator[m.Block]:
+        from fabric_mod_tpu.peer.deliverclient import DeliverDisconnected
         import grpc
         seek = make_seek_envelope(self._channel_id, start, stop)
         stream = self._client.stream_stream(
@@ -39,8 +40,14 @@ class GrpcDeliverSource:
                     yield resp.block
                 else:
                     return                 # terminal status
-        except grpc.RpcError:
-            return                         # disconnect: caller retries
+        except grpc.RpcError as e:
+            if stop_event is not None and stop_event.is_set():
+                return                     # our own cancel, clean end
+            # single-endpoint source: a dropped stream is TYPED (the
+            # caller stamps the committed height) instead of ending
+            # silently as if the seek range were served
+            raise DeliverDisconnected(
+                f"deliver stream dropped: {e!r}") from e
         finally:
             stream.cancel()
 

@@ -1,0 +1,23 @@
+"""Shared env-knob parsing: one malformed-value policy everywhere.
+
+Every FABRIC_MOD_TPU_* tuning knob parses through these two helpers,
+so the edge behavior (unset or garbage → the documented default,
+never a crash at import) cannot drift between subsystems.
+"""
+from __future__ import annotations
+
+import os
+
+
+def env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default

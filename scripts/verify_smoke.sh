@@ -2,7 +2,10 @@
 # CPU smoke target for the verify + commit pipeline:
 #   0. the FMT_RACECHECK=1 canary slice (concurrency guards armed
 #      over every retrofitted threaded structure) + the
-#      deterministic-clock raft elections
+#      deterministic-clock raft elections + the fault-injection
+#      scenario tier (deliver drop/failover, device-error sw
+#      fallback + circuit breaker, leader-crash broadcast retry,
+#      commit crash-resume) run with the race guards armed
 #   1. the mixed-ladder verdict differential (incl. the fused-hash
 #      raw-vs-digest check)
 #   2. the fused hash->verify A/B
@@ -30,6 +33,17 @@ cd "$(dirname "$0")/.."
 FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
     -p no:cacheprovider -p no:randomly \
     tests/test_racecheck.py tests/test_raft_fakeclock.py
+# 0b. the fault/chaos slice, ALSO under FMT_RACECHECK=1 (the
+#     permanently-armed lane): one deliver-drop -> typed disconnect +
+#     resume, one device-error -> sw-fallback (verdicts bit-identical,
+#     breaker open/probe/re-close), one raft leader crash -> broadcast
+#     NOT_LEADER retry on ManualClock, plus the commit crash-resume
+#     fingerprint differential — every retry/failover thread runs with
+#     the race guards armed, so new fault-handling code is race-checked
+#     the day it lands
+FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
+    -p no:cacheprovider -p no:randomly \
+    tests/test_faults.py
 # CPU XLA compiles of the verify cores run multiple minutes each (the
 # persistent compile cache is TPU-oriented); give the worker room.
 export FABRIC_MOD_TPU_BENCH_TIMEOUT="${FABRIC_MOD_TPU_BENCH_TIMEOUT:-2400}"
