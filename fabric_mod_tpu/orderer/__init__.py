@@ -1,4 +1,6 @@
 """The ordering service (reference: orderer/)."""
+from fabric_mod_tpu.orderer.admission import (                           # noqa: F401
+    AdmissionController, ResourceExhaustedError)
 from fabric_mod_tpu.orderer.blockcutter import BatchConfig, BlockCutter  # noqa: F401
 from fabric_mod_tpu.orderer.blockwriter import BlockWriter               # noqa: F401
 from fabric_mod_tpu.orderer.broadcast import Broadcast, BroadcastError   # noqa: F401

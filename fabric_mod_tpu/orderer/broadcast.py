@@ -17,12 +17,22 @@ election of latency, not a lost transaction — and re-raises it typed
 when the window outlasts the budget, carrying the best leader hint so
 the transport layer can answer SERVICE_UNAVAILABLE + redirect
 (reference: etcdraft's ErrNoLeader → Status SERVICE_UNAVAILABLE).
+
+Overload (the other half): when any admission knob is armed
+(orderer/admission.py), submit() consults the AdmissionController
+BEFORE the processor's signature work — per-client token buckets and
+the occupancy/latency overload gate shed normal txs with the typed,
+retryable ResourceExhaustedError (+ retry-after) while config and
+lifecycle traffic always passes.  Unarmed, this path is one None
+check: PR 6 behavior exactly.
 """
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 from fabric_mod_tpu.channelconfig import ConfigTxError
+from fabric_mod_tpu.orderer import admission as admission_mod
 from fabric_mod_tpu.orderer.consensus import NotLeaderError
 from fabric_mod_tpu.orderer.msgprocessor import MsgRejectedError
 from fabric_mod_tpu.orderer.registrar import Registrar
@@ -50,10 +60,14 @@ class BroadcastError(Exception):
 
 class Broadcast:
     def __init__(self, registrar: Registrar,
-                 retrier: Optional[Retrier] = None):
+                 retrier: Optional[Retrier] = None,
+                 admission=None):
         """`retrier` overrides the NOT_LEADER retry policy (tests pass
         one whose sleep drives a ManualClock); default: jittered
-        backoff under the FABRIC_MOD_TPU_BROADCAST_RETRY_S deadline."""
+        backoff under the FABRIC_MOD_TPU_BROADCAST_RETRY_S deadline.
+        `admission` overrides the knob-built AdmissionController
+        (tests pass one with a ManualClock); with every admission knob
+        unset the default is None and submit() is the PR 6 path."""
         self._registrar = registrar
         if retrier is None:
             deadline = broadcast_retry_s()
@@ -63,18 +77,36 @@ class Broadcast:
                 max_attempts=1 if deadline <= 0 else None,
                 retry_on=(NotLeaderError,), name="broadcast")
         self._retrier = retrier
+        if admission is None:
+            admission = admission_mod.AdmissionController.from_env()
+        self._admission = admission
 
     def submit(self, env: m.Envelope) -> None:
         """Accept one envelope for ordering; raises BroadcastError on
-        client-caused rejection (maps to BAD_REQUEST on the wire) and
+        client-caused rejection (maps to BAD_REQUEST on the wire),
         NotLeaderError — after the retry budget — when the ordering
         service has no leader (maps to SERVICE_UNAVAILABLE: the
-        client's cue to back off or follow the leader hint)."""
+        client's cue to back off or follow the leader hint), and
+        admission_mod.ResourceExhaustedError when admission sheds the
+        submission (maps to RESOURCE_EXHAUSTED + retry-after)."""
+        adm = self._admission
+        t0 = time.perf_counter() if adm is not None else 0.0
         try:
             support, is_config_update = \
                 self._registrar.broadcast_channel_support(env)
         except Exception as e:
             raise BroadcastError(f"routing: {e}") from e
+        if adm is not None:
+            # BEFORE the processor: shedding must cost ONE header
+            # parse, not a signature-policy evaluation (classify
+            # decodes the payload once; the client hash is skipped
+            # when no limiter is armed).  Gate state is per channel —
+            # a hot channel never sheds its idle neighbor
+            client, priority = admission_mod.classify(
+                env, is_config_update, need_client=adm.has_limiter)
+            adm.admit(client, priority,
+                      admission_mod.chain_occupancy(support.chain),
+                      channel=support.channel_id)
         if is_config_update:
             try:
                 wrapped, seq = \
@@ -93,3 +125,10 @@ class Broadcast:
             except _CLIENT_FAULTS as e:
                 raise BroadcastError(f"rejected: {e}") from e
             self._retrier.call(support.chain.order, env, seq)
+        if adm is not None:
+            # accepted-path latency only: a shed raised before this
+            # point, and feeding shed latencies into the EWMA would
+            # let fast rejections close the gate they caused (the
+            # gate's wall-time decay handles the all-shed window)
+            adm.note_latency(time.perf_counter() - t0,
+                             channel=support.channel_id)

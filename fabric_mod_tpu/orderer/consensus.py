@@ -17,6 +17,7 @@ import queue
 import threading
 from typing import Optional
 
+from fabric_mod_tpu.orderer import admission
 from fabric_mod_tpu.protos import messages as m
 
 
@@ -60,7 +61,14 @@ class SoloChain:
 
     def __init__(self, support):
         self._support = support
-        self._q: "queue.Queue[Optional[_Msg]]" = queue.Queue(maxsize=10_000)
+        # FABRIC_MOD_TPU_SUBMIT_QUEUE bounds the ingress queue with
+        # NON-blocking puts (typed shed on full); unset keeps the
+        # blocking 10k queue — the pre-admission behavior, byte for
+        # byte (the differential test pins this)
+        cap = admission.submit_queue_cap()
+        self._bounded = cap > 0
+        self._q: "queue.Queue[Optional[_Msg]]" = queue.Queue(
+            maxsize=cap if self._bounded else 10_000)
         self._halted = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
@@ -72,7 +80,14 @@ class SoloChain:
         if self._halted.is_set():
             return
         self._halted.set()
-        self._q.put(None)                 # wake the loop
+        try:
+            # wake-up only: get() blocks solely on an EMPTY queue, so
+            # the sentinel is needed exactly when put_nowait succeeds;
+            # a blocking put on a FULL bounded queue would deadlock
+            # against a run loop that already exited on _halted
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
         self._thread.join(timeout=10)
 
     def wait_ready(self) -> None:
@@ -83,11 +98,57 @@ class SoloChain:
 
     def order(self, env: m.Envelope, config_seq: int) -> None:
         self.wait_ready()
-        self._q.put(_Msg(env, False, config_seq))
+        self._enqueue(_Msg(env, False, config_seq), is_config=False)
 
     def configure(self, env: m.Envelope, config_seq: int) -> None:
         self.wait_ready()
-        self._q.put(_Msg(env, True, config_seq))
+        self._enqueue(_Msg(env, True, config_seq), is_config=True)
+
+    def submit_queue_depth(self):
+        """(qsize, maxsize) — the occupancy signal the overload gate
+        watches."""
+        return self._q.qsize(), self._q.maxsize
+
+    def _enqueue(self, msg: _Msg, is_config: bool) -> None:
+        """Bounded mode sheds a full queue typed instead of blocking
+        the broadcast handler; config txs keep the blocking put (the
+        queue is bounded, so they wait for drain rather than shed —
+        an operator's relief config must always get through).  The
+        full-path re-check extends that to every PRIORITY envelope
+        (lifecycle, orderer txs): "always admitted" must hold at the
+        queue too, not only at the gate — the classify parse runs
+        only on the Full path, never on the fast path."""
+        if not self._bounded:
+            self._q.put(msg)
+            return
+        if is_config:
+            self._put_priority(msg)
+            return
+        try:
+            self._q.put_nowait(msg)
+        except queue.Full:
+            if admission.is_priority(msg.env):
+                self._put_priority(msg)
+                return
+            raise admission.shed(
+                "queue_full",
+                f"submit queue full ({self._q.maxsize})",
+                retry_after_s=min(5.0, self._support.batch_timeout_s()),
+            ) from None
+
+    def _put_priority(self, msg: _Msg) -> None:
+        """Bounded-mode blocking put for priority traffic, in slices
+        that re-check _halted: priority waits for drain rather than
+        shed, but a halted chain must answer typed instead of wedging
+        the broadcast handler thread forever."""
+        while True:
+            if self._halted.is_set():
+                raise ChainHaltedError("chain is halted")
+            try:
+                self._q.put(msg, timeout=0.25)
+                return
+            except queue.Full:
+                continue
 
     # -- the ordering loop ----------------------------------------------
     def _run(self) -> None:

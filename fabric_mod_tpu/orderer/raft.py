@@ -344,7 +344,14 @@ class RaftNode:
         self._next_index: Dict[str, int] = {}
         self._match_index: Dict[str, int] = {}
         self._snap_sent: Dict[str, float] = {}
-        self._q: "queue.Queue" = queue.Queue()
+        # bounded FSM queue (FABRIC_MOD_TPU_RAFT_QUEUE, 0 = unbounded):
+        # a peer flooding Step messages can no longer grow host memory
+        # without bound — overflow drops the MESSAGE (raft re-sends;
+        # AppendEntries/vote traffic is idempotent-by-protocol) and
+        # counts it, the same observability as the chain-level drops
+        from fabric_mod_tpu.utils.env import env_int
+        self._q: "queue.Queue" = queue.Queue(
+            maxsize=max(0, env_int("FABRIC_MOD_TPU_RAFT_QUEUE", 8192)))
         self._stop = threading.Event()
         self._deadline = 0.0
         # pluggable time source: election/heartbeat deadlines are
@@ -359,15 +366,37 @@ class RaftNode:
             self._now = clock.monotonic
             subscribe = getattr(clock, "subscribe", None)
             if subscribe is not None:
-                subscribe(lambda: self._q.put(("noop",)))
+                # advisory wakeup: a full queue is by definition a
+                # non-empty queue, so a dropped noop never strands the
+                # FSM wait
+                subscribe(lambda: self._put_advisory(("noop",)))
         # machine-checked single-threaded-FSM contract (the -race
         # analog, utils/racecheck.py): every state transition must run
         # on the FSM thread — a stray cross-thread call raises
         from fabric_mod_tpu.utils.racecheck import ThreadOwnership
         self._fsm_owner = ThreadOwnership(f"raft-fsm[{node_id}]")
         self._thread = threading.Thread(target=self._run, daemon=True)
-        transport.register(node_id, lambda src, msg:
-                           self._q.put(("msg", src, msg)))
+        transport.register(node_id, self._on_transport_msg)
+
+    # -- queue admission --------------------------------------------------
+    def _on_transport_msg(self, src: str, msg) -> None:
+        try:
+            self._q.put_nowait(("msg", src, msg))
+        except queue.Full:
+            # surface the drop (the old unbounded queue grew instead):
+            # the protocol repairs — heartbeats re-send entries, votes
+            # re-request on timeout
+            from fabric_mod_tpu.orderer.admission import \
+                chain_drop_counter
+            chain_drop_counter().with_labels("raft_msg").add(1)
+
+    def _put_advisory(self, item) -> None:
+        """Wakeup-only items: dropping one on a full queue is safe —
+        the queue being full already wakes the FSM."""
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            pass
 
     # -- public ----------------------------------------------------------
     def start(self) -> None:
@@ -376,16 +405,25 @@ class RaftNode:
 
     def stop(self) -> None:
         self._stop.set()
-        self._q.put(("noop",))
+        self._put_advisory(("noop",))
         self._thread.join(timeout=5)
         self._wal.close()
 
     def propose(self, data: bytes) -> bool:
-        """Leader-only; returns False when not the leader (caller
-        forwards to `leader_id` — reference: chain Submit :494)."""
+        """Leader-only; returns False when not the leader OR when the
+        FSM queue is full (caller forwards to `leader_id` or requeues —
+        reference: chain Submit :494).  The bounded-queue False is
+        honest backpressure: the proposer re-offers instead of the old
+        unbounded enqueue."""
         if self.state != LEADER:
             return False
-        self._q.put(("propose", data))
+        try:
+            self._q.put_nowait(("propose", data))
+        except queue.Full:
+            from fabric_mod_tpu.orderer.admission import \
+                chain_drop_counter
+            chain_drop_counter().with_labels("raft_msg").add(1)
+            return False
         return True
 
     def update_peers(self, node_ids) -> None:
@@ -395,7 +433,17 @@ class RaftNode:
         apply-time reconfiguration, the reference's ConfChange-on-
         config-block model (etcdraft chain.go's raft.ApplyConfChange).
         Callers must change at most ONE member per config (quorum
-        overlap; enforced by the chain layer)."""
+        overlap; enforced by the chain layer).  A reconfig is never
+        dropped: callers off the FSM thread use a blocking put (the
+        FSM drains); the FSM thread itself (the apply path) must not
+        block against its own consumer, so a full queue applies the
+        reconfig synchronously instead."""
+        if threading.current_thread() is self._thread:
+            try:
+                self._q.put_nowait(("reconfig", list(node_ids)))
+            except queue.Full:
+                self._on_reconfig(list(node_ids))
+            return
         self._q.put(("reconfig", list(node_ids)))
 
     @property

@@ -18,13 +18,18 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from typing import List, Optional, Tuple
 
 from fabric_mod_tpu import faults
+from fabric_mod_tpu.observability.logging import get_logger
+from fabric_mod_tpu.orderer import admission
 from fabric_mod_tpu.orderer.consensus import ChainHaltedError, NotLeaderError
 from fabric_mod_tpu.orderer.raft import RaftNode, RaftTransport
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
+
+_log = get_logger("orderer.raftchain")
 
 _NORMAL, _CONFIG = 0, 1
 
@@ -98,7 +103,21 @@ class RaftChain:
             # committed entries, never campaign
             self._raft.member = False
         transport.register(f"{node_id}:chain", self._on_chain_msg)
-        self._q: "queue.Queue[Optional[_Submit]]" = queue.Queue(10_000)
+        # FABRIC_MOD_TPU_SUBMIT_QUEUE bounds ingress with non-blocking
+        # puts (typed shed); unset = the blocking 10k queue, unchanged
+        cap = admission.submit_queue_cap()
+        self._bounded = cap > 0
+        self._q: "queue.Queue[Optional[_Submit]]" = queue.Queue(
+            cap if self._bounded else 10_000)
+        # already-ACKED submits that hit a full queue are PARKED, not
+        # dropped — their clients got SUCCESS, so nobody would retry a
+        # silent drop.  _parked is the run loop's own (single-thread);
+        # _overflow absorbs forwarded submits arriving on transport
+        # threads; both are bounded by _PARKED_CAP, and only a submit
+        # past BOTH bounds is truly dropped (counted + logged).
+        self._parked: List[_Submit] = []
+        self._overflow: "deque[_Submit]" = deque()
+        self._overflow_lock = threading.Lock()
         self._halted = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         # Applied-index recovery: each block records the raft index of
@@ -118,7 +137,13 @@ class RaftChain:
         if self._halted.is_set():
             return
         self._halted.set()
-        self._q.put(None)
+        try:
+            # wake-up only (see SoloChain.halt): a blocking put on a
+            # full bounded queue would deadlock against a run loop
+            # that already exited on _halted
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
         self._thread.join(timeout=5)
         self._raft.stop()
 
@@ -136,12 +161,64 @@ class RaftChain:
 
     def order(self, env: m.Envelope, config_seq: int) -> None:
         self._admission_check()
-        self._q.put(_Submit(env.encode(), False, config_seq))
+        self._enqueue_submit(_Submit(env.encode(), False, config_seq),
+                             is_config=False)
 
     def configure(self, env: m.Envelope, config_seq: int) -> None:
         self._admission_check()
         self._check_membership_change(env)
-        self._q.put(_Submit(env.encode(), True, config_seq))
+        self._enqueue_submit(_Submit(env.encode(), True, config_seq),
+                             is_config=True)
+
+    def submit_queue_depth(self):
+        """(qsize, maxsize) — the occupancy signal the overload gate
+        watches."""
+        return self._q.qsize(), self._q.maxsize
+
+    def _enqueue_submit(self, sub: "_Submit", is_config: bool) -> None:
+        """Bounded mode answers a full queue with the typed shed
+        (clients retry after the hint) instead of blocking the
+        broadcast handler; config submits keep the blocking put — the
+        bounded queue drains, and the relief config must land.  The
+        full-path re-check extends that to every PRIORITY envelope
+        (lifecycle, orderer txs), mirroring SoloChain: "always
+        admitted" holds at the queue too, and the decode+classify
+        cost is paid only on the Full path."""
+        if not self._bounded:
+            self._q.put(sub)
+            return
+        if is_config:
+            self._put_priority(sub)
+            return
+        try:
+            self._q.put_nowait(sub)
+        except queue.Full:
+            try:
+                env = m.Envelope.decode(sub.env_bytes)
+            except Exception:
+                env = None
+            if env is not None and admission.is_priority(env):
+                self._put_priority(sub)
+                return
+            raise admission.shed(
+                "queue_full",
+                f"submit queue full ({self._q.maxsize})",
+                retry_after_s=min(5.0, self._support.batch_timeout_s()),
+            ) from None
+
+    def _put_priority(self, sub: "_Submit") -> None:
+        """Bounded-mode blocking put for priority traffic in
+        halted-aware slices (see SoloChain._put_priority): priority
+        waits for drain, but never wedges a handler thread against a
+        halted chain."""
+        while True:
+            if self._halted.is_set():
+                raise ChainHaltedError("chain is halted")
+            try:
+                self._q.put(sub, timeout=0.25)
+                return
+            except queue.Full:
+                continue
 
     def _admission_check(self) -> None:
         """Reject a submission this node can neither order nor forward
@@ -204,21 +281,67 @@ class RaftChain:
             try:
                 self._q.put_nowait(msg)
             except queue.Full:
-                pass                       # backpressure: sender retries
+                # the FOLLOWER already acked this submit — park it for
+                # the run loop to re-inject as the queue drains; only
+                # overflow past the parked bound is a real drop, and
+                # that one is counted + logged (a starved follower
+                # must not be indistinguishable from a healthy idle
+                # one)
+                with self._overflow_lock:
+                    if len(self._overflow) < self._PARKED_CAP:
+                        self._overflow.append(msg)
+                        return
+                admission.chain_drop_counter().with_labels(
+                    "forward").add(1)
+                _log.debug(
+                    "%s: dropped forwarded submit from %s "
+                    "(queue full at %d, overflow full at %d)",
+                    self.node_id, src, self._q.maxsize,
+                    self._PARKED_CAP)
 
     # -- the leader loop (reference: chain.go:533 run) --------------------
     def _propose_batch(self, envs: List[m.Envelope], kind: int,
                        config_seq: int) -> None:
         """Propose; on leadership loss between check and propose,
         requeue the envelopes so they are forwarded to the new leader
-        instead of vanishing (the cutter already released them)."""
-        if not self._raft.propose(_encode_batch(envs, kind)):
-            for env in envs:
-                try:
-                    self._q.put_nowait(_Submit(
-                        env.encode(), kind == _CONFIG, config_seq))
-                except queue.Full:
-                    break                  # backpressure: clients retry
+        instead of vanishing (the cutter already released them).
+        These submits were ACKED at admission, so a full queue PARKS
+        the remainder (this runs on the run-loop thread, which owns
+        _parked); only past the parked bound is anything dropped —
+        counted + logged.
+
+        propose() also returns False while STILL leader when the raft
+        FSM queue is full: retry the encoded batch with a short
+        hold-off instead of unwinding to envelopes — an immediate
+        requeue would busy-spin the run loop through decode +
+        revalidate + re-cut per attempt.  Blocking here is honest
+        backpressure: the submit queue fills behind us and sheds
+        typed."""
+        data = _encode_batch(envs, kind)
+        while not self._halted.is_set():
+            if self._raft.propose(data):
+                return
+            if not self.is_leader:
+                break                      # leadership lost: unwind
+            time.sleep(0.005)              # FSM queue full: hold off
+        subs = [_Submit(env.encode(), kind == _CONFIG, config_seq)
+                for env in envs]
+        for i, sub in enumerate(subs):
+            try:
+                self._q.put_nowait(sub)
+            except queue.Full:
+                rest = subs[i:]
+                space = max(0, self._PARKED_CAP - len(self._parked))
+                self._parked.extend(rest[:space])
+                dropped = len(rest) - space
+                if dropped > 0:
+                    admission.chain_drop_counter().with_labels(
+                        "requeue").add(dropped)
+                    _log.debug(
+                        "%s: dropped %d of %d reproposed envelopes "
+                        "on leadership loss (queue and parked both "
+                        "full)", self.node_id, dropped, len(envs))
+                break
 
     _PARKED_CAP = 10_000                   # mirrors the ingress queue
 
@@ -226,13 +349,14 @@ class RaftChain:
         support = self._support
         timer_deadline: Optional[float] = None
         was_leader = False
-        # submits ADMITTED (admission saw a live leader) but caught by
-        # a leaderless window before dispatch: parked, not dropped —
-        # the caller already got a successful return, so nobody would
-        # retry a silent drop.  Flushed back through the queue the
-        # moment a route (us as leader, or a known remote leader)
-        # exists; bounded like the ingress queue.
-        parked: List[_Submit] = []
+        # self._parked: submits ADMITTED (admission saw a live leader)
+        # but caught by a leaderless window or a full queue after the
+        # ack: parked, not dropped — the caller already got a
+        # successful return, so nobody would retry a silent drop.
+        # Flushed back through the queue the moment a route (us as
+        # leader, or a known remote leader) exists; bounded like the
+        # ingress queue.
+        parked = self._parked
         while not self._halted.is_set():
             timeout = 0.05
             if timer_deadline is not None:
@@ -244,6 +368,15 @@ class RaftChain:
                 sub = "tick"
             if sub is None:
                 break
+            # forwarded submits that found the queue full (transport
+            # threads park them in _overflow): re-inject as slots free
+            with self._overflow_lock:
+                while self._overflow:
+                    try:
+                        self._q.put_nowait(self._overflow[0])
+                    except queue.Full:
+                        break
+                    self._overflow.popleft()
             lead = self._raft.leader_id
             if parked and (self.is_leader or
                            (lead is not None and lead != self.node_id)):

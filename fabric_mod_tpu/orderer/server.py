@@ -15,6 +15,7 @@ import threading
 from typing import Iterator, Optional
 
 from fabric_mod_tpu.comm.grpc_comm import GRPCServer, MethodKind
+from fabric_mod_tpu.orderer.admission import ResourceExhaustedError
 from fabric_mod_tpu.orderer.broadcast import Broadcast, BroadcastError
 from fabric_mod_tpu.orderer.consensus import NotLeaderError
 from fabric_mod_tpu.orderer.deliver import DeliverService
@@ -69,6 +70,15 @@ class OrdererServer:
                 resp = m.BroadcastResponse(
                     status=m.Status.SERVICE_UNAVAILABLE,
                     info=f"no leader: retry{hint}")
+            except ResourceExhaustedError as e:
+                # admission shed: typed + retryable, carrying the
+                # server's retry-after hint so remote clients back off
+                # exactly that long (the grpcdeliver broadcast client
+                # parses this field)
+                resp = m.BroadcastResponse(
+                    status=m.Status.RESOURCE_EXHAUSTED,
+                    info=f"resource exhausted ({e.reason}): "
+                         f"retry_after={e.retry_after_s:.3f}")
             except Exception as e:
                 resp = m.BroadcastResponse(
                     status=m.Status.INTERNAL_SERVER_ERROR, info=str(e))

@@ -6,16 +6,27 @@ client with retry/failover — and the broadcast client the CLI uses.)
 `GrpcDeliverSource` has the same `blocks()` generator shape as the
 in-process DeliverService, so DeliverClient (and its MCS verification
 + pipelined commit) is transport-agnostic.
+
+`GrpcBroadcaster` is the ingress counterpart, now overload-aware: a
+RESOURCE_EXHAUSTED answer (admission shed, orderer/admission.py) is
+typed client-side and — when a `retrier` is configured — retried
+honoring the server's retry-after hint; a SERVICE_UNAVAILABLE answer
+carrying a leader hint re-dials the hinted consenter via `redial`
+BEFORE consuming any backoff budget (the ROADMAP's NOT_LEADER
+redirect-following — the hint has been on the wire since PR 5).
 """
 from __future__ import annotations
 
 import queue
+import re
 import threading
-from typing import Iterator, Optional, Sequence
+import time
+from typing import Callable, Iterator, Optional, Sequence
 
 from fabric_mod_tpu.comm.grpc_comm import GRPCClient
 from fabric_mod_tpu.orderer.server import SERVICE, make_seek_envelope
 from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.utils.retry import Retrier
 
 
 class GrpcDeliverSource:
@@ -52,26 +63,170 @@ class GrpcDeliverSource:
             stream.cancel()
 
 
+class BroadcastClientError(RuntimeError):
+    """Typed broadcast rejection: `status`/`info` carry the orderer's
+    answer.  Subclasses RuntimeError so pre-typed callers keep
+    working."""
+
+    def __init__(self, msg: str, status: int = 0, info: str = ""):
+        super().__init__(msg)
+        self.status = status
+        self.info = info
+
+
+class BroadcastUnavailable(BroadcastClientError):
+    """SERVICE_UNAVAILABLE (no leader); `leader_hint` is the consenter
+    id the orderer suggested, or None."""
+
+    def __init__(self, msg: str, info: str = "",
+                 leader_hint: Optional[str] = None):
+        super().__init__(msg, m.Status.SERVICE_UNAVAILABLE, info)
+        self.leader_hint = leader_hint
+
+
+class BroadcastResourceExhausted(BroadcastClientError):
+    """RESOURCE_EXHAUSTED (admission shed); `retry_after_s` is the
+    server's backoff hint."""
+
+    def __init__(self, msg: str, info: str = "",
+                 retry_after_s: float = 0.25):
+        super().__init__(msg, m.Status.RESOURCE_EXHAUSTED, info)
+        self.retry_after_s = retry_after_s
+
+
+def _parse_leader_hint(info: str) -> Optional[str]:
+    got = re.search(r"\btry (\S+)", info or "")
+    return got.group(1) if got else None
+
+
+def _parse_retry_after(info: str, default: float = 0.25) -> float:
+    got = re.search(r"\bretry_after=([0-9.]+)", info or "")
+    try:
+        return float(got.group(1)) if got else default
+    except ValueError:
+        return default
+
+
 class GrpcBroadcaster:
     """Streaming broadcast client: submit() enqueues an envelope and
     returns the orderer's ack status (reference: the broadcast client
-    of internal/pkg + peer CLI)."""
+    of internal/pkg + peer CLI).
 
-    def __init__(self, client: GRPCClient):
-        self._client = client
-        self._q: "queue.Queue[Optional[bytes]]" = queue.Queue()
-        self._resps = self._client.stream_stream(
-            SERVICE, "Broadcast", iter(self._q.get, None))
+    `retrier`: retries RESOURCE_EXHAUSTED answers, sleeping AT LEAST
+    the server's retry-after hint on top of its own backoff schedule;
+    None (the default) surfaces the first typed answer — the
+    pre-admission behavior.  `redial(consenter_id) -> GRPCClient`
+    enables leader-redirect following: a SERVICE_UNAVAILABLE answer
+    naming a leader re-dials it and resubmits immediately, without
+    consuming retry budget (redirect-dialed clients are owned and
+    closed by this object).  The per-stream send queue is BOUNDED
+    (`queue_cap`) so a wedged stream surfaces a typed error instead of
+    buffering unboundedly."""
+
+    _MAX_REDIRECTS = 3                     # per submit() call
+
+    def __init__(self, client: GRPCClient,
+                 retrier: Optional[Retrier] = None,
+                 redial: Optional[Callable[[str], GRPCClient]] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 queue_cap: int = 1024):
+        self._retrier = retrier
+        self._redial = redial
+        self._sleep = sleep
+        self._queue_cap = queue_cap
         self._lock = threading.Lock()
+        self._owned: list = []             # redirect-dialed clients
+        self._hint_wait = 0.0              # pending retry-after hint
+        self._open(client)
+
+    def _open(self, client: GRPCClient) -> None:
+        self._client = client
+        self._q: "queue.Queue[Optional[bytes]]" = queue.Queue(
+            maxsize=self._queue_cap)
+        self._resps = client.stream_stream(
+            SERVICE, "Broadcast", iter(self._q.get, None))
+
+    def _reconnect(self, client: GRPCClient) -> None:
+        """Swap streams (caller holds the lock): end the old stream;
+        redirect-owned clients are closed, the caller's original
+        client stays theirs to close."""
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
+        if self._client in self._owned:
+            self._owned.remove(self._client)
+            try:
+                self._client.close()
+            except Exception:
+                pass
+        self._owned.append(client)
+        self._open(client)
 
     def submit(self, env: m.Envelope) -> None:
+        """Raises BroadcastClientError (typed by status) when the
+        orderer rejects; with a `retrier`, RESOURCE_EXHAUSTED answers
+        are retried within its budget before surfacing."""
+        raw = env.encode()
         with self._lock:
-            self._q.put(env.encode())
-            raw = next(self._resps)
-        resp = m.BroadcastResponse.decode(raw)
-        if resp.status != m.Status.SUCCESS:
-            raise RuntimeError(
-                f"broadcast rejected: {resp.status} {resp.info}")
+            self._hint_wait = 0.0
+            if self._retrier is None:
+                self._submit_once(raw)
+            else:
+                self._retrier.call(self._submit_once, raw)
+
+    def _submit_once(self, raw: bytes, redirects: int = 0) -> None:
+        hint, self._hint_wait = self._hint_wait, 0.0
+        if hint > 0.0:
+            # honor the server's retry-after ON TOP of the retrier's
+            # own backoff: the total wait is never shorter than the
+            # hint, so a retrying client can't hammer a shedding node
+            self._sleep(hint)
+        try:
+            self._q.put_nowait(raw)
+        except queue.Full:
+            raise BroadcastResourceExhausted(
+                f"local broadcast queue full ({self._queue_cap})",
+                retry_after_s=0.25) from None
+        resp = m.BroadcastResponse.decode(next(self._resps))
+        if resp.status == m.Status.SUCCESS:
+            return
+        if resp.status == m.Status.RESOURCE_EXHAUSTED:
+            retry_after = _parse_retry_after(resp.info)
+            self._hint_wait = retry_after
+            raise BroadcastResourceExhausted(
+                f"broadcast rejected: {resp.status} {resp.info}",
+                info=resp.info, retry_after_s=retry_after)
+        if resp.status == m.Status.SERVICE_UNAVAILABLE:
+            lead = _parse_leader_hint(resp.info)
+            if lead is not None and self._redial is not None \
+                    and redirects < self._MAX_REDIRECTS:
+                # follow the redirect BEFORE any backoff: the hinted
+                # leader is (per the answering node) ready now
+                client = None
+                try:
+                    client = self._redial(lead)
+                except Exception:
+                    pass
+                if client is not None:
+                    self._reconnect(client)
+                    return self._submit_once(raw, redirects + 1)
+            raise BroadcastUnavailable(
+                f"broadcast rejected: {resp.status} {resp.info}",
+                info=resp.info, leader_hint=lead)
+        raise BroadcastClientError(
+            f"broadcast rejected: {resp.status} {resp.info}",
+            status=resp.status, info=resp.info)
 
     def close(self) -> None:
-        self._q.put(None)
+        with self._lock:
+            try:
+                self._q.put_nowait(None)
+            except queue.Full:
+                pass
+            for client in self._owned:
+                try:
+                    client.close()
+                except Exception:
+                    pass
+            del self._owned[:]

@@ -768,6 +768,10 @@ class Status:
     FORBIDDEN = 403
     NOT_FOUND = 404
     REQUEST_ENTITY_TOO_LARGE = 413
+    # admission shed (orderer/admission.py): retryable, with a
+    # retry-after hint serialized in BroadcastResponse.info (the gRPC
+    # RESOURCE_EXHAUSTED analog on the reference's HTTP-ish scale)
+    RESOURCE_EXHAUSTED = 429
     INTERNAL_SERVER_ERROR = 500
     NOT_IMPLEMENTED = 501
     SERVICE_UNAVAILABLE = 503

@@ -72,6 +72,10 @@ MATRIX = [
      {}, 900),
     ("gossip_nocache", ["--metric", "gossip", "--memo-cache", "0"],
      {}, 900),
+    # host-only but captured alongside: the ingress admission A/B
+    # (gated vs ungated overload burst + consistency gate)
+    ("broadcaststorm", ["--metric", "broadcaststorm", "--batch", "512"],
+     {}, 900),
 ]
 
 

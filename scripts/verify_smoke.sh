@@ -44,9 +44,22 @@ FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
 FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
     -p no:cacheprovider -p no:randomly \
     tests/test_faults.py
+# 0c. the backpressure slice, same permanently-armed FMT_RACECHECK=1
+#     lane: token-bucket/watermark units, the knobs-unset blocking-put
+#     differential, RESOURCE_EXHAUSTED + retry-after over a real gRPC
+#     socket, and the in-process mini broadcast storm (admitted =>
+#     committed exactly once, sheds typed) — every admission thread
+#     runs with the race guards armed from the day it lands
+FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
+    -p no:cacheprovider -p no:randomly -m 'not slow' \
+    tests/test_backpressure.py
 # CPU XLA compiles of the verify cores run multiple minutes each (the
 # persistent compile cache is TPU-oriented); give the worker room.
 export FABRIC_MOD_TPU_BENCH_TIMEOUT="${FABRIC_MOD_TPU_BENCH_TIMEOUT:-2400}"
+# broadcaststorm: the ingress admission A/B (gated vs ungated 4x
+# overload burst, consistency gate: zero admitted-then-lost, sheds
+# typed) — host-only, small N, bounded wall time
 exec python bench.py --cpu --batch "${SMOKE_BATCH:-64}" --reps 1 \
     --metric diffverify --metric hashverify \
-    --metric commitpipe --commitpipe-verifier sw
+    --metric commitpipe --commitpipe-verifier sw \
+    --metric broadcaststorm

@@ -1,0 +1,896 @@
+"""Admission control + backpressure: overload sheds, never collapses.
+
+The ingress tolerance tier (ISSUE 7): per-client token buckets on a
+ManualClock, overload-gate watermark hysteresis, config-tx priority
+under full shed, the typed RESOURCE_EXHAUSTED + retry-after answer on
+the gRPC surface (with the client honoring the hint through the
+shared Retrier and following NOT_LEADER redirects), the storm
+invariant — every admitted envelope commits exactly once, every shed
+is answered typed — both in-process and across real OS processes
+(procnet), and the FMT_FAULTS seam that forces the gate open.
+
+The knobs-unset differential also lives here: with no admission knob
+set, the ingress is byte-identical to the pre-admission path —
+blocking queue puts, no limiter, no controller.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+
+import pytest
+
+from fabric_mod_tpu import faults
+from fabric_mod_tpu.orderer import admission
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.utils.fakeclock import ManualClock
+from fabric_mod_tpu.utils.retry import Retrier
+
+# ---------------------------------------------------------------------------
+# token bucket / limiter on the manual clock
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_schedule_manualclock():
+    clock = ManualClock()
+    lim = admission.ClientRateLimiter(rate=2.0, burst=2.0, clock=clock)
+    # burst admits 2, the third is throttled with the REAL deficit
+    assert lim.admit("c1") == 0.0
+    assert lim.admit("c1") == 0.0
+    wait = lim.admit("c1")
+    assert wait == pytest.approx(0.5)
+    # half the deficit is not enough; the full deficit is
+    clock.advance(0.25)
+    assert lim.admit("c1") == pytest.approx(0.25)
+    clock.advance(0.3)
+    assert lim.admit("c1") == 0.0
+    # a second client draws from its OWN bucket
+    assert lim.admit("c2") == 0.0
+    assert lim.throttles_by_client()["c1"] >= 2
+
+
+def test_limiter_table_is_bounded_lru():
+    clock = ManualClock()
+    lim = admission.ClientRateLimiter(rate=1.0, burst=1.0, clock=clock,
+                                      max_clients=2)
+    assert lim.admit("a") == 0.0
+    assert lim.admit("b") == 0.0
+    assert lim.admit("c") == 0.0           # evicts "a" (oldest)
+    assert set(lim._buckets) == {"b", "c"}
+    # an evicted client restarts with a FULL bucket: biased toward
+    # admitting, never toward wedging
+    assert lim.admit("a") == 0.0
+    assert set(lim._buckets) == {"c", "a"}
+
+
+# ---------------------------------------------------------------------------
+# overload gate: hysteresis + latency EWMA
+# ---------------------------------------------------------------------------
+
+
+def test_gate_watermark_hysteresis():
+    gate = admission.OverloadGate(high=0.9, low=0.6)
+    assert gate.observe(0.5) is False
+    assert gate.observe(0.89) is False     # below high: stays closed
+    assert gate.observe(0.9) is True       # opens AT the watermark
+    assert gate.observe(0.7) is True       # in the band: stays open
+    assert gate.observe(0.61) is True      # still above low
+    assert gate.observe(0.6) is False      # closes AT the low mark
+    assert gate.observe(0.7) is False      # re-entering the band from
+    #                                        below does NOT re-open
+
+
+def test_gate_latency_ewma_trigger():
+    clock = ManualClock()
+    gate = admission.OverloadGate(high=0.9, low=0.6, lat_high_s=0.1,
+                                  clock=clock)
+    for _ in range(40):
+        gate.note_latency(0.5)             # EWMA -> ~0.5 >> 0.1
+    assert gate.observe(0.0) is True       # latency alone opens it
+    # occupancy at zero is not enough to close: the EWMA must halve
+    assert gate.observe(0.0) is True
+    for _ in range(80):
+        gate.note_latency(0.0)
+    assert gate.latency_ewma_s < 0.05
+    assert gate.observe(0.0) is False
+
+
+def test_latency_opened_gate_decays_shut_without_samples():
+    """An open gate sheds the very traffic whose latencies feed the
+    EWMA, so the EWMA must DECAY on wall time — otherwise one stall
+    latches the gate (and the ingress) shut forever."""
+    clock = ManualClock()
+    gate = admission.OverloadGate(high=0.9, low=0.6, lat_high_s=0.5,
+                                  clock=clock)
+    for _ in range(40):
+        gate.note_latency(2.0)             # the stall
+    assert gate.observe(0.0) is True
+    # no accepted samples ever again (everything sheds); wall time
+    # alone must bring the EWMA under lat_high/2 and close the gate
+    clock.advance(6.0)                     # 3 half-lives (4 * 0.5s)
+    assert gate.observe(0.0) is False
+    assert gate.latency_ewma_s < 0.25
+
+
+def test_gate_state_is_per_channel():
+    """A hot channel's open gate must not shed an idle neighbor's
+    traffic, and the idle channel's 0.0 occupancy samples must not
+    defeat the hot channel's hysteresis."""
+    ctl = _controller()
+    ctl.gate_for("hot").observe(1.0)       # hot channel slams open
+    with pytest.raises(admission.ResourceExhaustedError):
+        ctl.admit("c1", priority=False, occupancy=0.95, channel="hot")
+    # the idle channel admits freely...
+    ctl.admit("c1", priority=False, occupancy=0.0, channel="cold")
+    # ...and its samples did NOT close the hot gate
+    with pytest.raises(admission.ResourceExhaustedError):
+        ctl.admit("c1", priority=False, occupancy=0.8, channel="hot")
+    assert ctl.gate_for("hot").is_open
+    assert not ctl.gate_for("cold").is_open
+
+
+def test_forged_creator_flood_cannot_mint_buckets():
+    """The limiter key is the UNAUTHENTICATED creator: a flood of
+    randomized creators must drain the shared newcomers bucket and
+    get rate_limited typed instead of receiving a fresh full bucket
+    (and LRU-evicting real clients) per envelope."""
+    clock = ManualClock()
+    lim = admission.ClientRateLimiter(rate=1.0, burst=1.0, clock=clock,
+                                      max_clients=4096)
+    budget = lim._newcomers.burst
+    refused = sum(1 for i in range(int(budget) + 50)
+                  if lim.admit(f"forged-{i}") > 0.0)
+    assert refused == 50                   # everything past the shared
+    #                                        newcomer budget sheds
+    assert len(lim._buckets) == int(budget)
+
+
+# ---------------------------------------------------------------------------
+# controller: priority bypass + forced (chaos) shed
+# ---------------------------------------------------------------------------
+
+
+def _controller(rate=None, clock=None):
+    clock = clock or ManualClock()
+    lim = (admission.ClientRateLimiter(rate, burst=rate, clock=clock)
+           if rate else None)
+    gate = admission.OverloadGate(high=0.9, low=0.6, clock=clock)
+    return admission.AdmissionController(limiter=lim, gate=gate,
+                                         clock=clock)
+
+
+def test_config_always_admitted_under_full_shed():
+    ctl = _controller()
+    ctl.gate.observe(1.0)                  # slam the gate open
+    with pytest.raises(admission.ResourceExhaustedError) as ei:
+        ctl.admit("c1", priority=False, occupancy=1.0)
+    assert ei.value.reason == "overloaded"
+    assert ei.value.retry_after_s > 0
+    # priority traffic passes the SAME controller state
+    ctl.admit("c1", priority=True, occupancy=1.0)
+
+
+def test_rate_limit_shed_is_typed_with_real_deficit():
+    clock = ManualClock()
+    ctl = _controller(rate=1.0, clock=clock)
+    ctl.admit("c1", priority=False, occupancy=0.0)
+    with pytest.raises(admission.ResourceExhaustedError) as ei:
+        ctl.admit("c1", priority=False, occupancy=0.0)
+    assert ei.value.reason == "rate_limited"
+    assert ei.value.retry_after_s == pytest.approx(1.0)
+    # priority ignores the empty bucket too
+    ctl.admit("c1", priority=True, occupancy=0.0)
+
+
+def test_fmt_faults_forces_the_gate():
+    """The chaos seam: a drop-mode rule at
+    orderer.admission.overload sheds normal txs typed (reason
+    "forced") while config traffic still passes — FMT_FAULTS can
+    drive the gate without a real overload."""
+    ctl = _controller()
+    plan = faults.FaultPlan().add("orderer.admission.overload",
+                                  mode="drop", nth=1, times=3)
+    with faults.active(plan):
+        with pytest.raises(admission.ResourceExhaustedError) as ei:
+            ctl.admit("c1", priority=False, occupancy=0.0)
+        assert ei.value.reason == "forced"
+        ctl.admit("c1", priority=True, occupancy=0.0)   # config passes
+    assert plan.fires("orderer.admission.overload") >= 1
+    # disarmed: the same call admits
+    ctl.admit("c1", priority=False, occupancy=0.0)
+
+
+def test_shed_metrics_exported():
+    ctl = _controller()
+    ctl.gate.observe(1.0)
+    with pytest.raises(admission.ResourceExhaustedError):
+        ctl.admit("c1", priority=False, occupancy=1.0)
+    from fabric_mod_tpu.observability.metrics import default_provider
+    text = default_provider().render_prometheus()
+    assert "fabric_orderer_admission_sheds_total" in text
+    assert 'reason="overloaded"' in text
+    assert "fabric_orderer_overload_gate_open" in text
+    assert "fabric_orderer_submit_queue_occupancy" in text
+
+
+# ---------------------------------------------------------------------------
+# chain-level bounded queues + the knobs-unset differential
+# ---------------------------------------------------------------------------
+
+
+class _StubSupport:
+    @staticmethod
+    def batch_timeout_s() -> float:
+        return 0.2
+
+
+def test_solochain_unset_knobs_is_blocking_put(monkeypatch):
+    """Differential: no knob -> the PR 6 queue (maxsize 10k) and a
+    BLOCKING put — order() on a full queue waits instead of
+    shedding."""
+    monkeypatch.delenv("FABRIC_MOD_TPU_SUBMIT_QUEUE", raising=False)
+    from fabric_mod_tpu.orderer.consensus import SoloChain
+    chain = SoloChain(_StubSupport())
+    assert chain._bounded is False
+    assert chain._q.maxsize == 10_000
+    # prove the put BLOCKS (not sheds) on a full queue: shrink the
+    # queue, fill it, and watch order() wait until a slot frees
+    chain._q = queue.Queue(maxsize=1)
+    chain._q.put_nowait("filler")
+    landed = threading.Event()
+
+    def submit():
+        chain.order(m.Envelope(payload=b"p"), 0)
+        landed.set()
+
+    t = threading.Thread(target=submit, daemon=True)
+    t.start()
+    assert not landed.wait(0.15)           # blocked, not shed
+    chain._q.get_nowait()                  # free a slot
+    assert landed.wait(2.0)
+    t.join(timeout=2)
+
+
+def test_solochain_bounded_knob_sheds_typed(monkeypatch):
+    monkeypatch.setenv("FABRIC_MOD_TPU_SUBMIT_QUEUE", "2")
+    from fabric_mod_tpu.orderer.consensus import SoloChain
+    chain = SoloChain(_StubSupport())      # not started: never drains
+    assert chain._bounded is True
+    env = m.Envelope(payload=b"p")
+    chain.order(env, 0)
+    chain.order(env, 0)
+    assert chain.submit_queue_depth() == (2, 2)
+    with pytest.raises(admission.ResourceExhaustedError) as ei:
+        chain.order(env, 0)
+    assert ei.value.reason == "queue_full"
+    assert ei.value.retry_after_s == pytest.approx(0.2)
+    # a CONFIG submit on the same full queue BLOCKS (priority traffic
+    # waits for drain, never sheds)
+    landed = threading.Event()
+
+    def submit_config():
+        chain.configure(env, 0)
+        landed.set()
+
+    t = threading.Thread(target=submit_config, daemon=True)
+    t.start()
+    assert not landed.wait(0.15)
+    chain._q.get_nowait()
+    assert landed.wait(2.0)
+    t.join(timeout=2)
+
+
+def test_lifecycle_tx_blocks_not_sheds_on_full_queue(monkeypatch):
+    """"Always admitted" must hold at the bounded queue too: a
+    _lifecycle endorser tx on a full queue BLOCKS like a config tx
+    instead of shedding queue_full."""
+    from fabric_mod_tpu.orderer.consensus import SoloChain
+    from fabric_mod_tpu.protos import protoutil
+
+    monkeypatch.setenv("FABRIC_MOD_TPU_SUBMIT_QUEUE", "1")
+    chain = SoloChain(_StubSupport())
+    chain.order(m.Envelope(payload=b"p"), 0)       # fill the queue
+    ext = m.ChaincodeHeaderExtension(
+        chaincode_id=m.ChaincodeID(name="_lifecycle")).encode()
+    ch = protoutil.make_channel_header(
+        m.HeaderType.ENDORSER_TRANSACTION, "bp", extension=ext)
+    sh = protoutil.make_signature_header(b"c", protoutil.new_nonce())
+    lc_env = m.Envelope(
+        payload=protoutil.make_payload(ch, sh, b"x").encode())
+    # sanity: a NORMAL tx on the same full queue sheds
+    with pytest.raises(admission.ResourceExhaustedError):
+        chain.order(m.Envelope(payload=b"p"), 0)
+    landed = threading.Event()
+
+    def submit_lifecycle():
+        chain.order(lc_env, 0)
+        landed.set()
+
+    t = threading.Thread(target=submit_lifecycle, daemon=True)
+    t.start()
+    assert not landed.wait(0.15)           # blocked, not shed
+    chain._q.get_nowait()
+    assert landed.wait(2.0)
+    t.join(timeout=2)
+
+
+class _RunSupport:
+    """Just enough support surface for a STARTED SoloChain: the
+    cutter blocks on `gate` so the test controls when the run loop is
+    busy vs drained."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        sup = self
+
+        class Cutter:
+            def ordered(self, env):
+                sup.gate.wait(10)
+                return [], False
+
+            def cut(self):
+                return []
+
+        class Writer:
+            def create_next_block(self, batch):
+                return object()
+
+            def write_block(self, block):
+                pass
+
+        self.cutter = Cutter()
+        self.writer = Writer()
+
+    @staticmethod
+    def sequence() -> int:
+        return 0
+
+    @staticmethod
+    def batch_timeout_s() -> float:
+        return 10.0
+
+
+def test_halt_does_not_deadlock_on_full_bounded_queue(monkeypatch):
+    """Shutdown under overload: halt() on a chain whose bounded queue
+    is still FULL must not block forever in the wake-up put (the run
+    loop exits on the halted flag without draining the queue)."""
+    from fabric_mod_tpu.orderer.consensus import SoloChain
+
+    monkeypatch.setenv("FABRIC_MOD_TPU_SUBMIT_QUEUE", "1")
+    sup = _RunSupport()
+    chain = SoloChain(sup)
+    chain.start()
+    env = m.Envelope(payload=b"p")
+    chain.order(env, 0)                    # run loop takes it, blocks
+    deadline = time.time() + 5
+    while chain._q.qsize() > 0 and time.time() < deadline:
+        time.sleep(0.01)
+    chain.order(env, 0)                    # queue now FULL (cap 1)
+    halted = threading.Event()
+
+    def do_halt():
+        chain.halt()
+        halted.set()
+
+    t = threading.Thread(target=do_halt, daemon=True)
+    t.start()
+    sup.gate.set()                         # run loop finishes + exits
+    assert halted.wait(5.0), \
+        "halt() wedged on the full bounded queue"
+    t.join(timeout=2)
+
+
+def test_priority_put_answers_typed_when_chain_halts(monkeypatch):
+    """A priority (config) submit waiting on a full bounded queue must
+    not wedge the handler thread when the chain halts mid-wait: it
+    raises the typed ChainHaltedError instead."""
+    from fabric_mod_tpu.orderer.consensus import ChainHaltedError, SoloChain
+
+    monkeypatch.setenv("FABRIC_MOD_TPU_SUBMIT_QUEUE", "1")
+    chain = SoloChain(_StubSupport())      # never started: no drain
+    env = m.Envelope(payload=b"p")
+    chain.order(env, 0)                    # fill the queue
+    outcome = []
+
+    def submit_config():
+        try:
+            chain.configure(env, 0)
+            outcome.append("landed")
+        except ChainHaltedError:
+            outcome.append("halted")
+
+    t = threading.Thread(target=submit_config, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert outcome == []                   # blocked, waiting for drain
+    chain._halted.set()                    # the chain goes down
+    t.join(timeout=3)
+    assert outcome == ["halted"]
+
+
+def test_broadcast_unset_knobs_has_no_admission(monkeypatch):
+    for k in ("FABRIC_MOD_TPU_SUBMIT_QUEUE", "FABRIC_MOD_TPU_INGRESS_RATE",
+              "FABRIC_MOD_TPU_SHED_LAT_S"):
+        monkeypatch.delenv(k, raising=False)
+    assert admission.enabled() is False
+    assert admission.AdmissionController.from_env() is None
+    from fabric_mod_tpu.orderer.broadcast import Broadcast
+
+    class _R:
+        pass
+    assert Broadcast(_R())._admission is None
+    monkeypatch.setenv("FABRIC_MOD_TPU_INGRESS_RATE", "10")
+    assert admission.enabled() is True
+    assert Broadcast(_R())._admission is not None
+
+
+def test_raftchain_forward_full_queue_parks_then_counts():
+    """Satellite: a follower->leader forward hitting queue.Full is
+    PARKED (the follower already acked it — a drop would lose an
+    admitted tx), and only overflow past the parked bound is a real
+    drop, counted + logged instead of silently vanishing."""
+    from fabric_mod_tpu.orderer.raftchain import RaftChain, _Submit
+    chain = RaftChain.__new__(RaftChain)   # just the forward path
+    chain.node_id = "o0"
+    chain._q = queue.Queue(maxsize=1)
+    chain._q.put_nowait(_Submit(b"x", False, 0))
+    chain._overflow = __import__("collections").deque()
+    chain._overflow_lock = threading.Lock()
+    chain._PARKED_CAP = 2                  # shrink the park bound
+    counter = admission.chain_drop_counter().with_labels("forward")
+    before = counter.value
+    chain._on_chain_msg("o1", _Submit(b"a", False, 0))
+    chain._on_chain_msg("o1", _Submit(b"b", False, 0))
+    assert len(chain._overflow) == 2       # parked, not dropped
+    assert counter.value == before
+    chain._on_chain_msg("o1", _Submit(b"c", False, 0))
+    assert counter.value == before + 1     # past BOTH bounds: counted
+    # non-submit messages are ignored without counting
+    chain._on_chain_msg("o1", object())
+    assert counter.value == before + 1
+
+
+def test_raft_fsm_queue_bounded_drop_counted(monkeypatch, tmp_path):
+    """Satellite: the raft FSM ingress queue is bounded; overflowed
+    peer messages drop with a counter (raft re-sends), proposals
+    report False (the chain requeues)."""
+    monkeypatch.setenv("FABRIC_MOD_TPU_RAFT_QUEUE", "2")
+    from fabric_mod_tpu.orderer.raft import RaftNode, RaftTransport
+    node = RaftNode("n1", ["n1", "n2"], RaftTransport(),
+                    str(tmp_path / "n1.wal"), lambda i, d: None)
+    counter = admission.chain_drop_counter().with_labels("raft_msg")
+    before = counter.value
+    for i in range(5):
+        node._on_transport_msg("n2", ("fake", i))
+    assert node._q.qsize() == 2
+    assert counter.value == before + 3
+    # a full queue also refuses proposals instead of growing
+    node.state = "leader"
+    assert node.propose(b"data") is False
+    assert counter.value == before + 4
+    node._wal.close()
+
+
+def test_grpc_broadcaster_queue_bounded():
+    from fabric_mod_tpu.peer.grpcdeliver import GrpcBroadcaster
+
+    class _StubClient:
+        def stream_stream(self, service, method, requests):
+            return iter([])                # never consumes
+
+    b = GrpcBroadcaster(_StubClient(), queue_cap=1)
+    assert b._q.maxsize == 1
+    b._q.put_nowait(b"wedge")              # simulate a wedged stream
+    from fabric_mod_tpu.peer.grpcdeliver import BroadcastResourceExhausted
+    with pytest.raises(BroadcastResourceExhausted):
+        b.submit(m.Envelope(payload=b"p"))
+
+
+# ---------------------------------------------------------------------------
+# a lean one-org ordering world (solo consenter) for the wire tests
+# ---------------------------------------------------------------------------
+
+
+def _mini_world(root, n_clients=1, max_message_count=4,
+                batch_timeout="50ms"):
+    """One org, one solo orderer, `n_clients` client identities —
+    the cheapest world that exercises the REAL ingress (Writers
+    policy, cutter, writer, store)."""
+    from fabric_mod_tpu.bccsp.sw import SwCSP
+    from fabric_mod_tpu.channelconfig import genesis
+    from fabric_mod_tpu.msp import ca as calib
+    from fabric_mod_tpu.msp.identities import SigningIdentity
+    from fabric_mod_tpu.orderer import Registrar
+
+    csp = SwCSP()
+    org_ca = calib.CA("ca.org1", "Org1")
+    ord_ca = calib.CA("ca.orderer", "OrdererOrg")
+    ocert, okey = ord_ca.issue("orderer0", "OrdererOrg",
+                               ous=["orderer"])
+    signer = SigningIdentity("OrdererOrg", ocert, calib.key_pem(okey),
+                             csp)
+    clients = []
+    for i in range(n_clients):
+        cert, key = org_ca.issue(f"client{i}@org1", "Org1",
+                                 ous=["client"])
+        clients.append(SigningIdentity("Org1", cert,
+                                       calib.key_pem(key), csp))
+    gblock = genesis.standard_network(
+        "bpchan", {"Org1": [calib.cert_pem(org_ca.cert)]},
+        {"OrdererOrg": [calib.cert_pem(ord_ca.cert)]},
+        max_message_count=max_message_count,
+        batch_timeout=batch_timeout)
+    registrar = Registrar(str(root), signer, csp)
+    support = registrar.create_channel(gblock)
+    return clients, registrar, support
+
+
+def _mini_env(signer, tx_id):
+    from fabric_mod_tpu.protos import protoutil
+    ch = protoutil.make_channel_header(
+        m.HeaderType.ENDORSER_TRANSACTION, "bpchan", tx_id=tx_id)
+    sh = protoutil.make_signature_header(signer.serialize(),
+                                         protoutil.new_nonce())
+    payload = protoutil.make_payload(ch, sh, b"bp-" + tx_id.encode())
+    return protoutil.sign_envelope(payload, signer)
+
+
+# ---------------------------------------------------------------------------
+# the gRPC surface: RESOURCE_EXHAUSTED + retry-after, redirects
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedClient:
+    """A GRPCClient stand-in whose Broadcast stream answers from a
+    script (one BroadcastResponse per request)."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.requests = []
+
+    def stream_stream(self, service, method, requests):
+        def gen():
+            for raw in requests:
+                self.requests.append(raw)
+                yield self.script.pop(0).encode()
+        return gen()
+
+    def close(self):
+        pass
+
+
+def test_client_honors_retry_after_with_shared_retrier():
+    from fabric_mod_tpu.peer.grpcdeliver import (
+        BroadcastResourceExhausted, GrpcBroadcaster)
+    client = _ScriptedClient([
+        m.BroadcastResponse(
+            status=m.Status.RESOURCE_EXHAUSTED,
+            info="resource exhausted (rate_limited): retry_after=0.700"),
+        m.BroadcastResponse(status=m.Status.SUCCESS),
+    ])
+    backoffs, hints = [], []
+    retrier = Retrier(base_s=0.05, max_s=0.05, jitter=0.0,
+                      max_attempts=3,
+                      retry_on=(BroadcastResourceExhausted,),
+                      sleep=backoffs.append, name="test-bcast-re")
+    b = GrpcBroadcaster(client, retrier=retrier, sleep=hints.append)
+    b.submit(m.Envelope(payload=b"p"))     # retried to success
+    assert backoffs == [0.05]              # the retrier's own schedule
+    assert hints == [pytest.approx(0.7)]   # PLUS the server's hint
+    assert len(client.requests) == 2
+
+
+def test_client_surfaces_exhausted_typed_without_retrier():
+    from fabric_mod_tpu.peer.grpcdeliver import (
+        BroadcastResourceExhausted, GrpcBroadcaster)
+    client = _ScriptedClient([m.BroadcastResponse(
+        status=m.Status.RESOURCE_EXHAUSTED,
+        info="resource exhausted (queue_full): retry_after=0.250")])
+    b = GrpcBroadcaster(client)
+    with pytest.raises(BroadcastResourceExhausted) as ei:
+        b.submit(m.Envelope(payload=b"p"))
+    assert ei.value.retry_after_s == pytest.approx(0.25)
+    # still a RuntimeError: pre-typed callers keep working
+    assert isinstance(ei.value, RuntimeError)
+
+
+def test_client_follows_not_leader_redirect():
+    """ROADMAP satellite: SERVICE_UNAVAILABLE + leader hint re-dials
+    the hinted consenter BEFORE consuming any backoff budget."""
+    from fabric_mod_tpu.peer.grpcdeliver import GrpcBroadcaster
+    follower = _ScriptedClient([m.BroadcastResponse(
+        status=m.Status.SERVICE_UNAVAILABLE,
+        info="no leader: retry; try o2")])
+    leader = _ScriptedClient([m.BroadcastResponse(
+        status=m.Status.SUCCESS)])
+    dialed = []
+
+    def redial(node_id):
+        dialed.append(node_id)
+        return leader
+
+    slept = []
+    b = GrpcBroadcaster(follower, redial=redial, sleep=slept.append)
+    b.submit(m.Envelope(payload=b"p"))     # no retrier: redirect only
+    assert dialed == ["o2"]
+    assert slept == []                     # zero backoff consumed
+    assert len(leader.requests) == 1
+    b.close()
+
+
+def test_client_redirect_loop_is_bounded():
+    from fabric_mod_tpu.peer.grpcdeliver import (
+        BroadcastUnavailable, GrpcBroadcaster)
+    naysayer = [m.BroadcastResponse(
+        status=m.Status.SERVICE_UNAVAILABLE,
+        info="no leader: retry; try o1")] * 8
+
+    dialed = []
+
+    def redial(node_id):
+        dialed.append(node_id)
+        return _ScriptedClient(list(naysayer))
+
+    b = GrpcBroadcaster(_ScriptedClient(list(naysayer)), redial=redial)
+    with pytest.raises(BroadcastUnavailable) as ei:
+        b.submit(m.Envelope(payload=b"p"))
+    assert ei.value.leader_hint == "o1"
+    assert len(dialed) == GrpcBroadcaster._MAX_REDIRECTS
+    b.close()
+
+
+def test_grpc_surface_resource_exhausted_end_to_end(monkeypatch,
+                                                    tmp_path):
+    """The real wire: a rate-limited orderer answers RESOURCE_EXHAUSTED
+    + retry-after, and the typed client error carries the parsed
+    hint."""
+    from fabric_mod_tpu.comm.grpc_comm import GRPCClient
+    from fabric_mod_tpu.orderer.server import OrdererServer
+    from fabric_mod_tpu.peer.grpcdeliver import (
+        BroadcastResourceExhausted, GrpcBroadcaster)
+
+    clients, registrar, _support = _mini_world(tmp_path)
+    srv = None
+    conn = None
+    try:
+        # one client identity, 0.5 tx/s, burst 1: the second submit
+        # in the window MUST shed with retry_after ~= 2s
+        monkeypatch.setenv("FABRIC_MOD_TPU_INGRESS_RATE", "0.5")
+        monkeypatch.setenv("FABRIC_MOD_TPU_INGRESS_BURST", "1")
+        srv = OrdererServer(registrar)     # builds its own Broadcast
+        srv.start()
+        conn = GRPCClient(f"127.0.0.1:{srv.port}")
+        bcast = GrpcBroadcaster(conn)
+        bcast.submit(_mini_env(clients[0], "wire-0"))  # burst token
+        with pytest.raises(BroadcastResourceExhausted) as ei:
+            bcast.submit(_mini_env(clients[0], "wire-1"))
+        assert ei.value.retry_after_s == pytest.approx(2.0, rel=0.25)
+        assert "rate_limited" in ei.value.info
+        bcast.close()
+    finally:
+        if conn is not None:
+            conn.close()
+        if srv is not None:
+            srv.stop()
+        registrar.close()
+
+
+# ---------------------------------------------------------------------------
+# storm invariant, in-process: admitted => committed exactly once
+# ---------------------------------------------------------------------------
+
+
+def test_storm_invariant_inprocess(monkeypatch, tmp_path):
+    """A many-client burst against a throttled solo orderer with the
+    full gated stack armed: every admitted envelope commits exactly
+    once, every shed is answered typed, the queue stays bounded."""
+    from fabric_mod_tpu.orderer.broadcast import Broadcast
+    from fabric_mod_tpu.protos import protoutil
+
+    monkeypatch.setenv("FABRIC_MOD_TPU_SUBMIT_QUEUE", "8")
+    clients, registrar, support = _mini_world(
+        tmp_path, n_clients=3, max_message_count=4,
+        batch_timeout="50ms")
+    try:
+        orig_write = support.writer.write_block
+
+        def slow_write(block, _o=orig_write):
+            time.sleep(0.03)               # the controlled overload
+            return _o(block)
+        support.writer.write_block = slow_write
+        bcast = Broadcast(registrar)       # knob-armed admission
+        assert bcast._admission is not None
+
+        envs = [(f"storm-{i}",
+                 _mini_env(clients[i % len(clients)], f"storm-{i}"))
+                for i in range(48)]
+
+        admitted, shed, errors = [], [], []
+        lock = threading.Lock()
+
+        def client_main(mine):
+            acc, sh, errs = [], [], []
+            for tx_id, env in mine:
+                try:
+                    bcast.submit(env)
+                    acc.append(tx_id)
+                except admission.ResourceExhaustedError as e:
+                    assert e.reason in ("queue_full", "overloaded",
+                                        "rate_limited")
+                    sh.append(tx_id)
+                except Exception as e:     # noqa: BLE001
+                    errs.append(repr(e))
+            with lock:
+                admitted.extend(acc)
+                shed.extend(sh)
+                errors.extend(errs)
+
+        threads = [threading.Thread(
+            target=client_main, args=(envs[i::6],), daemon=True)
+            for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert errors == []                # every shed was TYPED
+        assert admitted, "nothing admitted"
+
+        # drain to exactly the admitted count
+        store = support.store
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            landed = sum(
+                len(store.get_block_by_number(i).data.data)
+                for i in range(1, store.height))
+            if landed >= len(admitted):
+                break
+            time.sleep(0.02)
+        committed = []
+        for n in range(1, store.height):
+            for env in protoutil.get_envelopes(
+                    store.get_block_by_number(n)):
+                committed.append(
+                    protoutil.envelope_channel_header(env).tx_id)
+        assert sorted(committed) == sorted(admitted)   # exactly once,
+        #                                   nothing lost, nothing shed
+        #                                   committed
+    finally:
+        registrar.close()
+
+
+# ---------------------------------------------------------------------------
+# storm invariant on procnet: real processes, raft, the gRPC wire
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_storm_invariant_procnet(tmp_path, monkeypatch):
+    """The same invariant across real OS processes: a 3-orderer raft
+    network with admission knobs armed, a multi-threaded client burst
+    through the leader's gRPC ingress — every SUCCESS-acked envelope
+    is served back by deliver exactly once, every shed is the typed
+    RESOURCE_EXHAUSTED answer.
+
+    slow-marked: the tier-1 sweep already exhausts its wall budget
+    before reaching the (alphabetically later) procnet module, so an
+    extra full ProcNet spin here would only displace passing tests;
+    the in-process storm above plus `bench.py --metric
+    broadcaststorm` (the verify_smoke slice) carry the fast lane."""
+    from fabric_mod_tpu.peer.grpcdeliver import (
+        BroadcastResourceExhausted, GrpcBroadcaster, GrpcDeliverSource)
+    from fabric_mod_tpu.protos import protoutil
+    from tests.test_procnet import ProcNet, _wait
+
+    # knobs travel to the orderer processes via the spawn environment
+    monkeypatch.setenv("FABRIC_MOD_TPU_SUBMIT_QUEUE", "64")
+    # one client identity shared by every thread: a 60-tx burst
+    # against a 5 tx/s bucket MUST shed most of it typed (the
+    # wheel-less Writers verify bounds the offered rate ~30/s, so the
+    # limit sits well under it)
+    monkeypatch.setenv("FABRIC_MOD_TPU_INGRESS_RATE", "5")
+    monkeypatch.setenv("FABRIC_MOD_TPU_INGRESS_BURST", "5")
+    net = ProcNet(tmp_path)
+    try:
+        for oid in net.o_ids:
+            net.start_orderer(oid)
+        assert _wait(net.leader_known_by_all, t=150), \
+            "no raft leader elected/propagated"
+        leader = net.leader()
+
+        client_id = net._identity("Org1", "users", "user0")
+        endorsers = [net._identity("Org1", "peers", "peer0"),
+                     net._identity("Org2", "peers", "peer0")]
+        from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder
+
+        def tx(i):
+            b = RWSetBuilder()
+            b.add_write("mycc", f"storm{i}", b"v%d" % i)
+            env = protoutil.create_signed_tx(
+                "procchan", "mycc", b.build().encode(), client_id,
+                endorsers)
+            return protoutil.envelope_channel_header(env).tx_id, env
+
+        envs = [tx(i) for i in range(60)]
+        admitted, shed, errors = [], [], []
+        lock = threading.Lock()
+
+        def client_main(mine):
+            conn, bcast = net.broadcaster(leader)
+            acc, sh, errs = [], [], []
+            try:
+                for tx_id, env in mine:
+                    try:
+                        bcast.submit(env)
+                        acc.append(tx_id)
+                    except BroadcastResourceExhausted as e:
+                        assert e.retry_after_s > 0
+                        sh.append(tx_id)
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(repr(e))
+            finally:
+                bcast.close()
+                conn.close()
+            with lock:
+                admitted.extend(acc)
+                shed.extend(sh)
+                errors.extend(errs)
+
+        threads = [threading.Thread(
+            target=client_main, args=(envs[i::4],), daemon=True)
+            for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert errors == [], errors
+        assert admitted, "nothing admitted"
+        assert shed, "expected typed sheds from the armed limiter"
+
+        # deliver back from the leader and hold it to the invariant
+        from fabric_mod_tpu.comm.grpc_comm import GRPCClient
+        conn = GRPCClient(
+            f"127.0.0.1:{net.bports[leader]}",
+            server_root_pem=net.tls.cert_pem,
+            override_authority=f"{leader}.example.com")
+        try:
+            committed = []
+
+            def pull_once():
+                committed.clear()
+                src = GrpcDeliverSource(conn, "procchan")
+                stop = threading.Event()
+                stop_timer = threading.Timer(20.0, stop.set)
+                stop_timer.start()
+                try:
+                    for block in src.blocks(1, stop_event=stop,
+                                            timeout_s=2.0):
+                        for env in protoutil.get_envelopes(block):
+                            committed.append(
+                                protoutil.envelope_channel_header(
+                                    env).tx_id)
+                finally:
+                    stop_timer.cancel()
+
+            def all_landed():
+                try:
+                    pull_once()
+                except Exception:
+                    return False
+                return set(admitted) <= set(committed)
+
+            assert _wait(all_landed, t=90), \
+                f"admitted txs missing: " \
+                f"{sorted(set(admitted) - set(committed))[:5]}"
+            from collections import Counter
+            counts = Counter(committed)
+            assert all(c == 1 for c in counts.values()), \
+                {t: c for t, c in counts.items() if c > 1}
+            assert set(admitted) <= set(counts)
+            assert not (set(shed) & set(counts)), \
+                "shed txs must never commit"
+        finally:
+            conn.close()
+    finally:
+        net.teardown()
