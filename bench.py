@@ -1058,6 +1058,29 @@ def _storm_arm(root: str, envs_by_client, mat: dict, gated: bool,
     }
 
 
+def measure_soak(seed, n_events) -> dict:
+    """Sustained soak-under-churn (host-only): the full SoakHarness
+    run — mixed x509+idemix traffic across channels while the seeded
+    ChurnPlan joins peers, revokes ACLs, reshapes batches, changes the
+    consenter set, and kills leaders, with the background fault plan
+    permanently armed.  Every invariant (fingerprint convergence
+    within the recovery window, admitted => committed exactly once,
+    no thread leaks, throughput recovery) gates BEFORE any rate is
+    reported; the JSON carries per-event-kind recovery times and the
+    replayable seed + schedule."""
+    from fabric_mod_tpu.soak import SoakConfig, SoakHarness
+    cfg = SoakConfig(seed=seed, n_events=n_events)
+    log(f"soak: seed {cfg.seed}, {cfg.n_events} events, "
+        f"{cfg.n_channels} channels, {cfg.n_peers} peers")
+    harness = SoakHarness(cfg)
+    log(f"soak schedule: {harness.plan.to_json()}")
+    rep = harness.run()
+    log(f"soak: PASS — {rep['x509_txs']} x509 + {rep['idemix_txs']} "
+        f"idemix txs over {rep['wall_secs']}s, "
+        f"{rep['fault_fires']} background faults fired")
+    return rep
+
+
 def measure_broadcaststorm(n_txs: int, n_clients: int = 8) -> dict:
     """A/B overload burst through the REAL ingress (Broadcast ->
     SoloChain -> block store): gated arm (bounded queue + overload
@@ -1221,6 +1244,29 @@ def run_worker(args) -> int:
         }
         import jax
         out["platform"] = jax.devices()[0].platform
+        print(json.dumps(out))
+        return 0
+    if args.metric == "soak":
+        # host-only (no device): the churn-soak integration run; the
+        # invariants gate inside the harness — reaching here means
+        # every convergence/exactly-once/leak/recovery check passed
+        rep = measure_soak(args.soak_seed, args.soak_events)
+        out = {
+            "metric": "soak_churn_sustained_mixed_tx_per_sec",
+            "value": rep["mixed_tx_per_sec"],
+            "unit": "tx/s",
+            # first soak record: no prior baseline config to compare
+            # against — the gate is the invariants, not a ratio
+            "vs_baseline": None,
+            "x509_tx_per_sec": rep["x509_tx_per_sec"],
+            "idemix_tx_per_sec": rep["idemix_tx_per_sec"],
+            **{k: rep[k] for k in (
+                "seed", "wall_secs", "x509_txs", "idemix_txs",
+                "idemix_tamper_rejects", "audited_txs", "fault_fires",
+                "submit_errors", "peers_final", "channels")},
+            "recovery_s_by_kind": rep["recovery_s_by_kind"],
+            "schedule": rep["schedule"],
+        }
         print(json.dumps(out))
         return 0
     if args.metric == "broadcaststorm":
@@ -1468,6 +1514,12 @@ def supervise(args, argv) -> int:
             # fallback doesn't pay a multi-minute CPU XLA compile
             cpu_argv += ["--pipeline-depth", str(args.pipeline_depth),
                          "--commitpipe-verifier", "sw"]
+        if args.metric == "soak":
+            # replayability: the fallback must run the SAME schedule
+            if args.soak_seed is not None:
+                cpu_argv += ["--soak-seed", str(args.soak_seed)]
+            if args.soak_events is not None:
+                cpu_argv += ["--soak-events", str(args.soak_events)]
     result, note = _spawn_worker(cpu_argv, cpu_env, timeout_s)
     log(f"[bench] cpu fallback: {note}")
     if result is not None:
@@ -1494,7 +1546,7 @@ def main() -> int:
     ap.add_argument("--metric", action="append",
                     choices=("verify", "block", "e2e", "idemix", "gossip",
                              "marshal", "diffverify", "hashverify",
-                             "commitpipe", "broadcaststorm"),
+                             "commitpipe", "broadcaststorm", "soak"),
                     default=None,
                     help="repeatable: each metric runs in sequence and "
                          "prints its own JSON line (the smoke target "
@@ -1521,6 +1573,13 @@ def main() -> int:
                     default="device",
                     help="commitpipe: signature backend for BOTH arms "
                          "(sw = no XLA compile; the CPU smoke target)")
+    ap.add_argument("--soak-seed", type=int, default=None,
+                    help="soak: churn schedule seed (default "
+                         "FMT_SOAK_SEED or 8) — a failed run prints "
+                         "the seed to replay it here")
+    ap.add_argument("--soak-events", type=int, default=None,
+                    help="soak: churn events per run (default "
+                         "FMT_SOAK_EVENTS or 6)")
     ap.add_argument("--_worker", action="store_true",
                     help=argparse.SUPPRESS)
     args, _ = ap.parse_known_args()
@@ -1546,6 +1605,11 @@ def main() -> int:
         if metric == "commitpipe":
             argv += ["--pipeline-depth", str(args.pipeline_depth),
                      "--commitpipe-verifier", args.commitpipe_verifier]
+        if metric == "soak":
+            if args.soak_seed is not None:
+                argv += ["--soak-seed", str(args.soak_seed)]
+            if args.soak_events is not None:
+                argv += ["--soak-events", str(args.soak_events)]
         rc |= supervise(args, argv)
     return rc
 

@@ -82,6 +82,12 @@ class InProcNetwork:
 
     def send(self, src_endpoint: str, src_pki_id: bytes,
              dst_endpoint: str, env_bytes: bytes) -> bool:
+        # chaos seam (the faults-module docstring's canonical drop
+        # example): an armed drop-mode rule loses this message on the
+        # wire — gossip redelivery / anti-entropy must repair it, which
+        # is exactly what the soak's background plan asserts at scale
+        if faults.point("gossip.comm.drop"):
+            return False
         with self._lock:
             if (src_endpoint in self.partitioned or
                     dst_endpoint in self.partitioned):

@@ -56,7 +56,8 @@ class GossipNode:
             m.GossipMember(endpoint=endpoint, pki_id=self.pki_id),
             self._identity, self.comm)
         self.state = GossipStateProvider(
-            channel, request_missing=self._pull_range)
+            channel, request_missing=self._pull_range,
+            on_tick=self.pull_tick)
         # TTL'd duplicate suppression (reference: gossip msgstore) —
         # an entry is suppressed for exactly the TTL regardless of
         # arrival rate; a 200k-message burst cannot evict entries
@@ -351,12 +352,20 @@ class GossipNode:
             data_req=m.DataRequest(nonce=self._rng.getrandbits(63),
                                    digests=digests)))
 
+    # hello answers carry at most this many trailing block digests:
+    # the standing pull cadence must stay O(window), not O(height) —
+    # a deeply-behind puller still converges (each update raises its
+    # height, so successive pulls reveal successive windows), and the
+    # anti-entropy gap path handles bulk catch-up once pushes arrive
+    PULL_DIGEST_WINDOW = 64
+
     def _handle_hello(self, src: bytes, msg: m.GossipMessage) -> None:
         src_ep = self._members_by_pki.get(src)
         if src_ep is None:
             return
         height = self._channel.ledger.height
-        digests = [str(n).encode() for n in range(height)]
+        lo = max(0, height - self.PULL_DIGEST_WINDOW)
+        digests = [str(n).encode() for n in range(lo, height)]
         self.comm.send(src_ep, m.GossipMessage(
             data_dig=m.DataDigest(nonce=msg.hello.nonce,
                                   digests=digests)))

@@ -25,6 +25,7 @@ costs one election interval, not a stalled channel.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Optional
 
 from fabric_mod_tpu.gossip.election import LeaderElectionService
@@ -50,6 +51,7 @@ class GossipService:
         self._interval = election_interval_s
         self._client: Optional[DeliverClient] = None
         self._client_thread: Optional[threading.Thread] = None
+        self._client_halt: Optional[threading.Event] = None
         self._lock = threading.Lock()
         self.election = LeaderElectionService(
             node.pki_id,
@@ -102,12 +104,40 @@ class GossipService:
                 channel, self._factory(),
                 on_commit=self._node.gossip_block)
             self._client = client
+            halt = threading.Event()
+            self._client_halt = halt
 
             def run():
-                try:
-                    client.run(idle_timeout_s=3600.0)
-                except Exception as e:     # pragma: no cover
-                    log.warning("deliver client died: %s", e)
+                # the reference's DeliverBlocks retry loop
+                # (blocksprovider.go:141): while this peer HOLDS
+                # deliver leadership, a died client is restarted from
+                # the committed height with backoff — the client is
+                # reusable by contract (each run() builds fresh pipe
+                # workers).  Without the retry, one commit race or
+                # injected stream fault killed the org's ONLY orderer
+                # puller and every peer stalled at the tip forever
+                # (found by the soak harness's churn runs).
+                backoff = 0.2
+                while not halt.is_set():
+                    try:
+                        client.run(idle_timeout_s=3600.0)
+                        # clean end: either stop() landed (halt is
+                        # set — the loop exits above) or the source
+                        # went IDLE.  While this peer still leads,
+                        # re-run from the committed height: a quiet
+                        # stretch must not permanently orphan the
+                        # org's only orderer puller
+                        backoff = 0.2
+                        halt.wait(0.05)
+                    except Exception as e:
+                        if halt.is_set():
+                            return
+                        log.warning(
+                            "%s: deliver client died: %s — restarting "
+                            "from committed height",
+                            self._node.endpoint, e)
+                        halt.wait(backoff)
+                        backoff = min(2.0, backoff * 2)
 
             t = threading.Thread(target=run, daemon=True)
             self._client_thread = t
@@ -117,7 +147,23 @@ class GossipService:
         with self._lock:
             client, self._client = self._client, None
             thread, self._client_thread = self._client_thread, None
-        if client is not None:
-            client.stop()
+            halt, self._client_halt = self._client_halt, None
+        if halt is not None:
+            # BEFORE client.stop(): the restart loop must see the halt
+            # when run() returns, or it would re-arm a stopped client
+            halt.set()
         if thread is not None:
-            thread.join(timeout=10)
+            # re-issue stop() until the thread exits: a restart
+            # attempt that had already entered client.run() CLEARS the
+            # client's stop flag (the reusable-client contract), so a
+            # single stop() landing in that window would be erased and
+            # a demoted peer would keep pulling forever — each re-stop
+            # sticks until the next restart, and halt prevents any
+            # further restart
+            deadline = time.monotonic() + 10.0
+            while thread.is_alive() and time.monotonic() < deadline:
+                if client is not None:
+                    client.stop()
+                thread.join(timeout=0.5)
+        elif client is not None:
+            client.stop()

@@ -110,10 +110,19 @@ class GossipStateProvider:
     """Binds the buffer to a committer; the deliver loop commits
     blocks strictly in order (reference: state.go:583)."""
 
-    def __init__(self, channel, request_missing: Optional[Callable] = None):
+    def __init__(self, channel, request_missing: Optional[Callable] = None,
+                 on_tick: Optional[Callable] = None):
+        """`on_tick` runs on the anti-entropy cadence alongside the
+        gap check (the node wires its pull engine here): a block lost
+        at the chain TAIL leaves the payload buffer gapless — only a
+        periodic hello/digest pull can discover it, so without this
+        hook a dropped final push stalls an idle peer forever (found
+        by the soak harness's background-drop chaos plan)."""
         self._channel = channel
         self.buffer = PayloadsBuffer(channel.ledger.height)
         self._request_missing = request_missing
+        self._on_tick = on_tick
+        self._tick_seq = -1                # buffer progress marker
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # serializes pop->commit sequences: two concurrent drain()
@@ -193,6 +202,16 @@ class GossipStateProvider:
         gap = self.buffer.missing_range()
         if gap is not None and self._request_missing is not None:
             self._request_missing(gap)
+        # the pull hook fires only on a QUIESCENT channel (no buffer
+        # progress since the previous tick): while blocks are flowing
+        # the push path is clearly alive and a pull is pure overhead;
+        # when nothing moved, either we are fully caught up or the
+        # tail was lost — exactly the two cases only a pull can tell
+        # apart
+        seq = self.buffer.next_seq
+        if self._on_tick is not None and seq == self._tick_seq:
+            self._on_tick()
+        self._tick_seq = seq
         return gap
 
     # -- background mode --------------------------------------------------

@@ -610,9 +610,19 @@ class KvLedger:
         ledgers that committed the same blocks with the same verdicts
         agree bit-for-bit — the commit-pipeline differential's
         equality oracle (bench.py --metric commitpipe,
-        tests/test_commitpipe.py)."""
+        tests/test_commitpipe.py).
+
+        Taken under the COMMIT lock: commit_block advances the block
+        store before applying state, so an unlocked scan racing an
+        in-flight commit would hash height N+1 with block N's writes
+        missing — a phantom divergence that is pure read timing (the
+        soak harness's convergence checker hit exactly this on the
+        freshest block of whichever peer committed last)."""
         import hashlib
-        h = hashlib.sha256()
+        with self._lock:
+            return self._state_fingerprint_locked(hashlib.sha256())
+
+    def _state_fingerprint_locked(self, h) -> str:
         h.update(self.height.to_bytes(8, "big"))
 
         def upd(b: bytes) -> None:

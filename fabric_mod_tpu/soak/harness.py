@@ -1,0 +1,338 @@
+"""SoakHarness: sustained mixed traffic while churn moves underneath.
+
+One run = build the world, start the mixed x509+idemix workload, arm
+the permanently-on background fault plan (seeded probability rules on
+the PR 5 injection points), then walk the seeded ChurnPlan: traffic
+phase -> fire event -> converge-or-fail -> next.  At the tail the
+exactly-once ledger audit (with resubmission of kill-lost envelopes),
+the subscriber-cutoff assertion, teardown, and the thread-leak sweep.
+
+Every failure raises SoakError whose message carries the seed and the
+full schedule — `python bench.py --metric soak --soak-seed N` replays
+it, and ChurnPlan(N) regenerates the schedule bit-for-bit (asserted
+by tests/test_soak.py).
+
+Knobs (all env-overridable, the FMT_SOAK_* table in README):
+
+  FMT_SOAK_SEED           schedule + rng seed          (default 8)
+  FMT_SOAK_EVENTS         churn events per run         (default 6)
+  FMT_SOAK_CHANNELS       soak channels                (default 2)
+  FMT_SOAK_PEERS          peers at start (join events add more)  (2)
+  FMT_SOAK_GAP_TXS        "lo:hi" txs between events   (default 4:9)
+  FMT_SOAK_WINDOW_S       recovery window per event    (default 45)
+  FMT_SOAK_RECOVERY_FRAC  post/pre throughput floor    (default 0.05)
+  FMT_SOAK_X509_GAP_S     x509 lane inter-tx gap       (default 0.12)
+  FMT_SOAK_IDEMIX_GAP_S   idemix lane inter-tx gap     (default 1.0)
+  FMT_SOAK_FAULT_P        background fault probability (default 0.05)
+"""
+from __future__ import annotations
+
+import os
+import random
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+from fabric_mod_tpu import faults
+from fabric_mod_tpu.observability import get_logger
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+from fabric_mod_tpu.soak.invariants import InvariantChecker, SoakError
+from fabric_mod_tpu.soak.plan import ChurnPlan
+from fabric_mod_tpu.soak.workload import MixedWorkload
+from fabric_mod_tpu.soak.world import SoakWorld
+from fabric_mod_tpu.utils.env import env_float, env_int
+
+log = get_logger("soak.harness")
+
+
+class SoakConfig:
+    def __init__(self, seed: Optional[int] = None,
+                 n_events: Optional[int] = None,
+                 n_channels: Optional[int] = None,
+                 n_peers: Optional[int] = None,
+                 gap_txs: Optional[Tuple[int, int]] = None,
+                 recovery_window_s: Optional[float] = None,
+                 min_recovery_frac: Optional[float] = None,
+                 x509_gap_s: Optional[float] = None,
+                 idemix_gap_s: Optional[float] = None,
+                 fault_p: Optional[float] = None):
+        gap_env = os.environ.get("FMT_SOAK_GAP_TXS", "")
+        if gap_txs is None and gap_env:
+            try:
+                lo, _, hi = gap_env.partition(":")
+                gap_txs = (int(lo), int(hi or lo))
+            except ValueError:
+                gap_txs = None             # garbage knob: the default
+        self.seed = seed if seed is not None else \
+            env_int("FMT_SOAK_SEED", 8)
+        self.n_events = n_events if n_events is not None else \
+            env_int("FMT_SOAK_EVENTS", 6)
+        self.n_channels = n_channels if n_channels is not None else \
+            env_int("FMT_SOAK_CHANNELS", 2)
+        self.n_peers = n_peers if n_peers is not None else \
+            env_int("FMT_SOAK_PEERS", 2)
+        self.gap_txs = gap_txs or (4, 9)
+        self.recovery_window_s = recovery_window_s \
+            if recovery_window_s is not None else \
+            env_float("FMT_SOAK_WINDOW_S", 45.0)
+        self.min_recovery_frac = min_recovery_frac \
+            if min_recovery_frac is not None else \
+            env_float("FMT_SOAK_RECOVERY_FRAC", 0.05)
+        self.x509_gap_s = x509_gap_s if x509_gap_s is not None else \
+            env_float("FMT_SOAK_X509_GAP_S", 0.12)
+        self.idemix_gap_s = idemix_gap_s if idemix_gap_s is not None \
+            else env_float("FMT_SOAK_IDEMIX_GAP_S", 1.0)
+        self.fault_p = fault_p if fault_p is not None else \
+            env_float("FMT_SOAK_FAULT_P", 0.05)
+
+
+def background_fault_plan(seed: int, p: float) -> faults.FaultPlan:
+    """The permanently-armed chaos rider: seeded probability rules on
+    the PR 5 injection points, active for the WHOLE run.  gossip
+    drops are repaired by redelivery/anti-entropy, deliver stream
+    deaths by the failover source, raft submit faults by client
+    retry — each fired fault exercises the mechanism built for it."""
+    return (faults.FaultPlan()
+            .add("gossip.comm.drop", mode="drop", p=p, seed=seed)
+            .add("deliver.stream", p=p / 2, seed=seed + 1, kind="io")
+            .add("orderer.raft.submit", p=p / 4, seed=seed + 2,
+                 kind="io"))
+
+
+def _first_config_block_at_or_after(ledger, start: int) -> Optional[int]:
+    for num in range(max(1, start), ledger.height):
+        block = ledger.get_block_by_number(num)
+        if block is None:
+            continue
+        try:
+            env = protoutil.get_envelopes(block)[0]
+            payload = protoutil.unmarshal_envelope_payload(env)
+            ch = m.ChannelHeader.decode(payload.header.channel_header)
+            if ch.type == m.HeaderType.CONFIG:
+                return num
+        except Exception:
+            continue
+    return None
+
+
+class SoakHarness:
+    def __init__(self, config: Optional[SoakConfig] = None,
+                 root: Optional[str] = None):
+        self.cfg = config or SoakConfig()
+        self._root = root
+        self.plan = ChurnPlan(self.cfg.seed, self.cfg.n_events,
+                              gap_txs=self.cfg.gap_txs)
+        self._rng = random.Random(self.cfg.seed ^ 0xC0FFEE)
+
+    # -- event execution ---------------------------------------------------
+
+    def _wait_leaders(self, world: SoakWorld, timeout_s: float = 30.0):
+        deadline = time.monotonic() + timeout_s
+        for cid in world.channel_ids:
+            while world.leader_of(cid) is None:
+                if time.monotonic() > deadline:
+                    raise SoakError(
+                        f"no raft leader elected on {cid}", self.plan)
+                time.sleep(0.05)
+
+    def _fire(self, world: SoakWorld, kind: str) -> Dict:
+        """Execute one churn event; returns event-specific context the
+        post-convergence assertions use."""
+        ctx: Dict = {"kind": kind}
+        if kind == "peer_join":
+            ctx["peer"] = world.add_peer().name
+        elif kind == "acl_revoke":
+            ctx["pre_h"] = world.revoke_audit_org()
+        elif kind == "batch_config":
+            cid = world.channel_ids[
+                self._rng.randrange(len(world.channel_ids))]
+            ctx["channel"] = cid
+            ctx["max_message_count"] = world.set_batch_size(cid)
+        elif kind == "consenter_add":
+            ctx["orderer"] = world.add_consenter()
+        elif kind == "consenter_remove":
+            ctx["orderer"] = world.remove_consenter()
+        elif kind == "leader_kill":
+            # leadership can flip between the wait and the read (the
+            # clock pump keeps election timers moving): retry until a
+            # victim is actually caught, with a bounded budget
+            deadline = time.monotonic() + 30.0
+            victim = None
+            while victim is None:
+                self._wait_leaders(world)
+                victim = world.leader_of(world.channel_ids[0])
+                if victim is None and time.monotonic() > deadline:
+                    raise SoakError(
+                        "leader_kill: no stable leader to kill on "
+                        f"{world.channel_ids[0]}", self.plan)
+            ctx["orderer"] = victim
+            world.kill_orderer(victim)
+        else:                              # pragma: no cover
+            raise SoakError(f"unknown event kind {kind!r}", self.plan)
+        log.info("soak: fired %s %s", kind, ctx)
+        return ctx
+
+    def _post_event(self, world: SoakWorld, checker: InvariantChecker,
+                    ctx: Dict) -> None:
+        """Event-specific steady-state assertions (after convergence)."""
+        if ctx["kind"] == "acl_revoke":
+            sub = world.subscriber
+            cid0 = world.channel_ids[0]
+            ledger = world.peers[0].channels[cid0].ledger
+            cfg_num = _first_config_block_at_or_after(
+                ledger, ctx["pre_h"])
+            if cfg_num is None:
+                raise SoakError(
+                    "acl_revoke: no config block found on the event "
+                    "channel after the update", self.plan)
+            if not sub.done(timeout_s=checker.window_s):
+                raise SoakError(
+                    "acl_revoke: revoked subscriber still streaming "
+                    "after the revocation block committed", self.plan)
+            if sub.status != m.Status.FORBIDDEN:
+                raise SoakError(
+                    f"acl_revoke: subscriber ended with "
+                    f"{sub.status!r}, not FORBIDDEN "
+                    f"(error={sub.error!r})", self.plan)
+            late = [n for n in sub.received if n >= cfg_num]
+            if late:
+                raise SoakError(
+                    f"acl_revoke: subscriber received post-revocation "
+                    f"block(s) {late} (revocation at {cfg_num})",
+                    self.plan)
+            ctx["cut_at_block"] = cfg_num
+            ctx["received_before_cut"] = len(sub.received)
+        elif ctx["kind"] == "leader_kill":
+            # post-event traffic already committed, so the survivors
+            # MUST have elected a new, different leader by now — a
+            # None here means leadership wedged (leader_of can never
+            # return the dead orderer, so only the None and != checks
+            # are meaningful)
+            cid0 = world.channel_ids[0]
+            new_leader = world.leader_of(cid0)
+            if new_leader is None or new_leader == ctx["orderer"]:
+                raise SoakError(
+                    f"leader_kill: no replacement leader on {cid0} "
+                    f"after killing {ctx['orderer']} "
+                    f"(leader_of={new_leader!r})", self.plan)
+            ctx["new_leader"] = new_leader
+
+    def _run_traffic(self, workload: MixedWorkload, gap_txs: int,
+                     label: str) -> float:
+        """One mixed-traffic phase: wait until `gap_txs` more x509
+        submissions succeeded; returns the phase's submit rate."""
+        c0 = workload.counts()["x509"]
+        t0 = time.monotonic()
+        budget = max(30.0, gap_txs * (self.cfg.x509_gap_s + 2.0) * 4)
+        while workload.counts()["x509"] < c0 + gap_txs:
+            if workload.errors:
+                raise SoakError(f"workload failed during {label}: "
+                                f"{workload.errors}", self.plan)
+            if time.monotonic() - t0 > budget:
+                raise SoakError(
+                    f"traffic stalled during {label}: "
+                    f"{workload.counts()['x509'] - c0}/{gap_txs} txs "
+                    f"in {budget:.0f}s", self.plan)
+            time.sleep(0.05)
+        return gap_txs / max(1e-9, time.monotonic() - t0)
+
+    # -- the run -----------------------------------------------------------
+
+    def run(self) -> Dict:
+        if self._root is not None:
+            return self._run_in(self._root)
+        with tempfile.TemporaryDirectory(prefix="fmt_soak_") as root:
+            return self._run_in(root)
+
+    def _run_in(self, root: str) -> Dict:
+        cfg = self.cfg
+        t_start = time.monotonic()
+        world = SoakWorld(root, cfg.seed, n_channels=cfg.n_channels,
+                          n_peers=cfg.n_peers)
+        workload = MixedWorkload(world, x509_gap_s=cfg.x509_gap_s,
+                                 idemix_gap_s=cfg.idemix_gap_s)
+        checker = InvariantChecker(
+            world, workload, self.plan,
+            recovery_window_s=cfg.recovery_window_s,
+            min_recovery_frac=cfg.min_recovery_frac)
+        chaos = background_fault_plan(cfg.seed, cfg.fault_p)
+        events_report: List[Dict] = []
+        rates: List[float] = []
+        try:
+            with faults.active(chaos):
+                world.start()
+                self._wait_leaders(world)
+                workload.start()
+                checker.beat()
+                # warmup phase: prove the steady state BEFORE churn
+                rates.append(self._run_traffic(
+                    workload, max(3, cfg.gap_txs[0]), "warmup"))
+                checker.check_converged("warmup", record=False)
+                for ev in self.plan.events:
+                    rates.append(self._run_traffic(
+                        workload, ev.gap_txs, f"pre-{ev.kind}"))
+                    ctx = self._fire(world, ev.kind)
+                    ctx["recovery_s"] = round(
+                        checker.check_converged(ev.kind), 3)
+                    post_rate = self._run_traffic(
+                        workload, max(3, cfg.gap_txs[0]),
+                        f"post-{ev.kind}")
+                    checker.check_recovery_rate(ev.kind, rates[-1],
+                                                post_rate)
+                    ctx["pre_rate"] = round(rates[-1], 2)
+                    ctx["post_rate"] = round(post_rate, 2)
+                    rates.append(post_rate)
+                    self._post_event(world, checker, ctx)
+                    checker.check_lanes()
+                    events_report.append(ctx)
+                # tail: stop lanes, settle, audit the whole run
+                workload.stop()
+                checker.check_converged("final", record=False)
+                audited = checker.audit_exactly_once()
+                fault_fires = chaos.fires()
+                if fault_fires == 0:
+                    raise SoakError(
+                        "background fault plan never fired — the "
+                        "chaos rider is disconnected from its "
+                        "injection points", self.plan)
+        except SoakError:
+            raise
+        except Exception as e:
+            raise SoakError(f"soak run failed: {e!r}", self.plan) from e
+        finally:
+            try:
+                workload.stop()
+            except Exception:
+                pass
+            world.close()
+        checker.check_thread_leaks()
+        wall = time.monotonic() - t_start
+        counts = workload.counts()
+        report = {
+            "seed": cfg.seed,
+            "schedule": [e.to_dict() for e in self.plan.events],
+            "events": events_report,
+            "wall_secs": round(wall, 2),
+            "x509_txs": counts["x509"],
+            "idemix_txs": counts["idemix"],
+            "idemix_tamper_rejects": counts["idemix_tamper_rejects"],
+            "submit_errors": counts["submit_errors"],
+            "mixed_tx_per_sec": round(
+                (counts["x509"] + counts["idemix"]) / wall, 2),
+            "x509_tx_per_sec": round(counts["x509"] / wall, 2),
+            "idemix_tx_per_sec": round(counts["idemix"] / wall, 2),
+            "audited_txs": audited,
+            "fault_fires": fault_fires,
+            "recovery_s_by_kind": {
+                k: [round(x, 3) for x in v]
+                for k, v in checker.recovery_by_kind.items()},
+            "peers_final": len(world.peers),
+            "channels": world.channel_ids,
+        }
+        log.info("soak: PASS %s", report)
+        return report
+
+
+def run_soak(config: Optional[SoakConfig] = None) -> Dict:
+    return SoakHarness(config).run()

@@ -1,0 +1,621 @@
+"""The soak world: a full in-process network the churn plan perturbs.
+
+Topology (the integration layer over everything PRs 3-7 built):
+
+  N raft orderers (ManualClock-driven elections, one RaftTransport per
+  channel) x M channels, each orderer a Registrar + Broadcast;
+  K gossiping peers, each with its own ledger/channel per soak channel,
+  composed exactly like production: GossipNode (push + anti-entropy
+  pull) + GossipService (election-owned DeliverClient) over a
+  failover deliver source that rotates across LIVE orderers;
+  one EventDeliverServer (real gRPC socket) on peer p0 with the REAL
+  bundle-backed ACLProvider, holding the audit org's standing
+  BLOCK_UNTIL_READY subscription that an acl_revoke event must cut.
+
+ManualClock acceleration: a pump thread advances fake time
+continuously (default 2 fake-seconds per real second), so raft
+elections/heartbeats run at fake speed while message passing, gossip,
+and commit stay real-threaded — hours of election time compress into
+a tier-1 budget, the PR 4 deterministic-clock tier writ large.
+
+Orderer lifecycle primitives (`kill_orderer`, `add_consenter`,
+`remove_consenter`) and config primitives (`revoke_audit_org`,
+`set_batch_size`) are what the harness's event executor calls; each
+goes through the REAL path: signed config updates through
+Broadcast.submit -> msgprocessor -> chain.configure -> replicated
+config blocks -> peer bundle swaps.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional
+
+from fabric_mod_tpu.bccsp.sw import SwCSP
+from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
+from fabric_mod_tpu.channelconfig import (Bundle, compute_update, genesis,
+                                          signed_update_envelope)
+from fabric_mod_tpu.channelconfig.bundle import (BATCH_SIZE, CONSENSUS_TYPE,
+                                                 ORDERER, APPLICATION,
+                                                 groups_of, set_group,
+                                                 set_value, values_of)
+from fabric_mod_tpu.channelconfig.configtx import config_from_block
+from fabric_mod_tpu.comm.grpc_comm import GRPCClient
+from fabric_mod_tpu.concurrency import RegisteredThread, assert_joined
+from fabric_mod_tpu.gossip import GossipNode, GossipService, InProcNetwork
+from fabric_mod_tpu.ledger.kvledger import LedgerManager
+from fabric_mod_tpu.msp import ca as calib
+from fabric_mod_tpu.msp.identities import SigningIdentity
+from fabric_mod_tpu.observability import get_logger
+from fabric_mod_tpu.orderer import Broadcast, DeliverService
+from fabric_mod_tpu.orderer.raft import RaftTransport
+from fabric_mod_tpu.orderer.raftchain import RaftChain
+from fabric_mod_tpu.orderer.registrar import Registrar
+from fabric_mod_tpu.peer.aclmgmt import ACLProvider
+from fabric_mod_tpu.peer.channel import Channel
+from fabric_mod_tpu.peer.deliverevents import (EventDeliverClient,
+                                               EventDeliverServer,
+                                               EventStreamError)
+from fabric_mod_tpu.peer.endorser import Endorser
+from fabric_mod_tpu.peer.scc import build_default_registry
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.utils.fakeclock import ManualClock
+
+log = get_logger("soak.world")
+
+AUDIT_ORG = "AuditOrg"
+
+
+def _seeded_rng(seed: int, *parts: str) -> random.Random:
+    h = seed & 0xFFFFFFFF
+    for p in parts:
+        h = zlib.crc32(p.encode(), h)
+    return random.Random(h)
+
+
+class _FailoverSource:
+    """In-process deliver failover: the `blocks()` generator contract
+    of DeliverService/FailoverDeliverSource over whichever LIVE
+    orderer currently has the blocks.  A stream that dies (killed
+    orderer, idle timeout, or an injected `deliver.stream` fault — the
+    PR 5 seam) rotates to another orderer and re-seeks from the next
+    needed block; the consumer sees one gap-free sequence."""
+
+    def __init__(self, world: "SoakWorld", channel_id: str):
+        self._world = world
+        self._cid = channel_id
+        self.rotations = 0
+
+    def blocks(self, start: int = 0, stop: Optional[int] = None,
+               stop_event: Optional[threading.Event] = None,
+               timeout_s: float = 30.0):
+        num = start
+        while stop is None or num <= stop:
+            if stop_event is not None and stop_event.is_set():
+                return
+            sup = self._world.pick_deliver_support(self._cid, num)
+            if sup is None:
+                time.sleep(0.05)
+                continue
+            try:
+                for blk in DeliverService(sup).blocks(
+                        num, stop, stop_event=stop_event, timeout_s=1.0):
+                    yield blk
+                    num = blk.header.number + 1
+            except Exception:
+                # injected mid-stream fault or a dying orderer: the
+                # rotation below is the tolerance mechanism under test
+                pass
+            self.rotations += 1
+
+
+class _Orderer:
+    __slots__ = ("oid", "registrar", "broadcast", "signer", "dead",
+                 "removed")
+
+    def __init__(self, oid, registrar, broadcast, signer):
+        self.oid = oid
+        self.registrar = registrar
+        self.broadcast = broadcast
+        self.signer = signer
+        self.dead = False
+        self.removed = set()               # channels configured out
+
+
+class SoakPeer:
+    """One committing peer: a ledger + Channel + GossipNode +
+    GossipService per soak channel."""
+
+    def __init__(self, world: "SoakWorld", name: str, org: str):
+        self.name = name
+        self.org = org
+        self.world = world
+        cert, key = world.cas[org].issue(
+            f"{name}.{org.lower()}", org, ous=["peer"])
+        self.signer = SigningIdentity(org, cert, calib.key_pem(key),
+                                      world.csp)
+        self.ledger_mgr = LedgerManager(
+            os.path.join(world.root, "peers", name))
+        self.channels: Dict[str, Channel] = {}
+        self.nodes: Dict[str, GossipNode] = {}
+        self.services: Dict[str, GossipService] = {}
+        for cid in world.channel_ids:
+            ledger = self.ledger_mgr.create_or_open(cid)
+            _, config = config_from_block(world.genesis[cid])
+            channel = Channel(cid, ledger, FakeBatchVerifier(world.csp),
+                              Bundle(cid, config, world.csp), world.csp)
+            if ledger.height == 0:
+                channel.init_from_genesis(world.genesis[cid])
+            self.channels[cid] = channel
+            node = GossipNode(f"{name}.{cid}:7051", self.signer, channel,
+                              world.networks[cid],
+                              rng=_seeded_rng(world.seed, name, cid))
+            self.nodes[cid] = node
+            self.services[cid] = GossipService(
+                node, lambda cid=cid: _FailoverSource(world, cid),
+                election_interval_s=0.2)
+
+    def height(self, cid: str) -> int:
+        return self.channels[cid].ledger.height
+
+    def fingerprint(self, cid: str) -> str:
+        return self.channels[cid].ledger.state_fingerprint()
+
+    def start(self) -> None:
+        for svc in self.services.values():
+            svc.start()
+
+    def stop(self) -> None:
+        for svc in self.services.values():
+            svc.stop()
+        for node in self.nodes.values():
+            node.stop()
+        self.ledger_mgr.close()
+
+
+class _Subscriber:
+    """The audit org's standing event-deliver subscription: collects
+    received block numbers until the stream ends; an acl_revoke event
+    must end it FORBIDDEN without a single post-revocation block."""
+
+    def __init__(self, port: int, channel_id: str, signer):
+        self._client = GRPCClient(f"127.0.0.1:{port}")
+        self._evc = EventDeliverClient(self._client, channel_id, signer)
+        self.received: List[int] = []
+        self.status: Optional[int] = None
+        self.error: Optional[Exception] = None
+        self._thread = threading.Thread(target=self._run,
+                                        name="soak-audit-subscriber",
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            for blk in self._evc.blocks(start=0, stop=None,
+                                        timeout_s=3600.0):
+                self.received.append(blk.header.number)
+        except EventStreamError as e:
+            self.status = e.status
+        except Exception as e:             # transport teardown at close
+            self.error = e
+
+    def done(self, timeout_s: float) -> bool:
+        self._thread.join(timeout=timeout_s)
+        return not self._thread.is_alive()
+
+    def close(self) -> None:
+        self._client.close()
+        self._thread.join(timeout=10)
+
+
+class SoakWorld:
+    def __init__(self, root: str, seed: int, n_channels: int = 2,
+                 n_peers: int = 2, orgs=("Org1", "Org2"),
+                 orderer_ids=("o0", "o1", "o2"),
+                 max_message_count: int = 8,
+                 batch_timeout: str = "200ms",
+                 clock_step: float = 0.01,
+                 clock_interval: float = 0.005):
+        self.root = str(root)
+        self.seed = int(seed)
+        self.csp = SwCSP()
+        self.orgs = list(orgs)
+        self.channel_ids = [f"soak{i}" for i in range(n_channels)]
+        self.clock = ManualClock()
+        self._clock_step = clock_step
+        self._clock_interval = clock_interval
+        self._pump_stop = threading.Event()
+        self._pump: Optional[RegisteredThread] = None
+        self._lock = threading.Lock()
+        self._batch_counts: Dict[str, int] = {}
+        self._rr = 0
+
+        # crypto material: app orgs + the revocable audit org + orderer
+        self.cas = {org: calib.CA(f"ca.{org.lower()}", org)
+                    for org in self.orgs + [AUDIT_ORG]}
+        self.orderer_ca = calib.CA("ca.orderer", "OrdererOrg")
+        self.admins: Dict[str, SigningIdentity] = {}
+        for org in self.orgs + [AUDIT_ORG]:
+            cert, key = self.cas[org].issue(
+                f"admin@{org.lower()}", org, ous=["admin"])
+            self.admins[org] = SigningIdentity(org, cert,
+                                               calib.key_pem(key),
+                                               self.csp)
+        ocert, okey = self.orderer_ca.issue("admin@orderer", "OrdererOrg",
+                                            ous=["admin"])
+        self.orderer_admin = SigningIdentity(
+            "OrdererOrg", ocert, calib.key_pem(okey), self.csp)
+        ccert, ckey = self.cas[self.orgs[0]].issue(
+            f"client@{self.orgs[0].lower()}", self.orgs[0],
+            ous=["client"])
+        self.client = SigningIdentity(self.orgs[0], ccert,
+                                      calib.key_pem(ckey), self.csp)
+        acert, akey = self.cas[AUDIT_ORG].issue(
+            "auditor@audit", AUDIT_ORG, ous=["client"])
+        self.audit_client = SigningIdentity(AUDIT_ORG, acert,
+                                            calib.key_pem(akey), self.csp)
+
+        # genesis per channel (multi-channel: one ledger per channel,
+        # PAPER.md L3) — raft consenters declared in the config
+        org_cas = {org: [calib.cert_pem(self.cas[org].cert)]
+                   for org in self.orgs + [AUDIT_ORG]}
+        ord_cas = {"OrdererOrg": [calib.cert_pem(self.orderer_ca.cert)]}
+        self.genesis: Dict[str, m.Block] = {}
+        self.transports: Dict[str, RaftTransport] = {}
+        self.networks: Dict[str, InProcNetwork] = {}
+        for cid in self.channel_ids:
+            self.genesis[cid] = genesis.standard_network(
+                cid, org_cas, ord_cas, consensus_type="etcdraft",
+                consenters=list(orderer_ids),
+                batch_timeout=batch_timeout,
+                max_message_count=max_message_count)
+            self.transports[cid] = RaftTransport()
+            self.networks[cid] = InProcNetwork()
+            self._batch_counts[cid] = max_message_count
+
+        self.orderers: Dict[str, _Orderer] = {}
+        self._bootstrap_ids = list(orderer_ids)
+        for oid in orderer_ids:
+            self._boot_orderer(oid)
+
+        self.peers: List[SoakPeer] = []
+        for i in range(n_peers):
+            self.peers.append(SoakPeer(
+                self, f"p{i}", self.orgs[i % len(self.orgs)]))
+
+        # endorsers evaluate over p0's channel state (any replica
+        # works — endorsement is a read-time act)
+        self.endorsers: Dict[str, Dict[str, Endorser]] = {}
+        p0 = self.peers[0]
+        for cid in self.channel_ids:
+            registry = build_default_registry(
+                p0.channels[cid], p0.channels[cid].ledger)
+            per_org = {}
+            for org in self.orgs:
+                cert, key = self.cas[org].issue(
+                    f"endorser.{org.lower()}.{cid}", org, ous=["peer"])
+                per_org[org] = Endorser(
+                    p0.channels[cid], registry,
+                    SigningIdentity(org, cert, calib.key_pem(key),
+                                    self.csp))
+            self.endorsers[cid] = per_org
+
+        self.event_server: Optional[EventDeliverServer] = None
+        self.subscriber: Optional[_Subscriber] = None
+
+    # -- orderer lifecycle -------------------------------------------------
+
+    def _boot_orderer(self, oid: str) -> _Orderer:
+        ocert, okey = self.orderer_ca.issue(
+            f"{oid}.orderer", "OrdererOrg", ous=["orderer"])
+        signer = SigningIdentity("OrdererOrg", ocert,
+                                 calib.key_pem(okey), self.csp)
+        root = os.path.join(self.root, "ord", oid)
+
+        def factory(support, oid=oid):
+            cid = support.channel_id
+            return RaftChain(
+                oid, list(self._bootstrap_ids), self.transports[cid],
+                os.path.join(self.root, "ord", oid, f"{cid}.wal"),
+                support, clock=self.clock,
+                rng=_seeded_rng(self.seed, oid, cid))
+
+        reg = Registrar(root, signer, self.csp, chain_factory=factory)
+        for cid in self.channel_ids:
+            reg.create_channel(self.genesis[cid])
+        o = _Orderer(oid, reg, Broadcast(reg), signer)
+        with self._lock:
+            self.orderers[oid] = o
+        return o
+
+    def live_orderers(self) -> List[_Orderer]:
+        with self._lock:
+            return [o for o in self.orderers.values() if not o.dead]
+
+    def chains(self, cid: str) -> Dict[str, object]:
+        """Live, still-configured-in chains for a channel."""
+        out = {}
+        for o in self.live_orderers():
+            if cid in o.removed:
+                continue
+            sup = o.registrar.get_chain(cid)
+            if sup is not None:
+                out[o.oid] = sup.chain
+        return out
+
+    def supports(self, cid: str, voting_only: bool = True):
+        out = {}
+        for o in self.live_orderers():
+            if voting_only and cid in o.removed:
+                continue
+            sup = o.registrar.get_chain(cid)
+            if sup is not None:
+                out[o.oid] = sup
+        return out
+
+    def leader_of(self, cid: str) -> Optional[str]:
+        for oid, chain in self.chains(cid).items():
+            if getattr(chain, "is_leader", False):
+                return oid
+        return None
+
+    def pick_deliver_support(self, cid: str, at_least: int):
+        """The failover source's selector: any live orderer, highest
+        store first (a removed consenter's frozen store still serves
+        history it has)."""
+        best = None
+        for o in self.live_orderers():
+            sup = o.registrar.get_chain(cid)
+            if sup is None:
+                continue
+            if best is None or sup.store.height > best.store.height:
+                best = sup
+        return best
+
+    def pick_broadcast(self, cid: str) -> Broadcast:
+        """Prefer the channel leader (no forward hop); else rotate
+        through live orderers (the NOT_LEADER retry path)."""
+        lead = self.leader_of(cid)
+        with self._lock:
+            if lead is not None and not self.orderers[lead].dead:
+                return self.orderers[lead].broadcast
+            live = [o for o in self.orderers.values()
+                    if not o.dead and cid not in o.removed]
+            self._rr += 1
+            return live[self._rr % len(live)].broadcast
+
+    def kill_orderer(self, oid: str) -> None:
+        """SIGKILL analog: halt every chain, stop serving deliver."""
+        with self._lock:
+            o = self.orderers[oid]
+            o.dead = True
+        log.info("soak: killing orderer %s", oid)
+        for cid in self.channel_ids:
+            sup = o.registrar.get_chain(cid)
+            if sup is not None:
+                try:
+                    sup.chain.halt()
+                except Exception:
+                    pass
+
+    # -- config events -----------------------------------------------------
+
+    def _submit_update(self, cid: str, desired: m.ConfigGroup,
+                       signers, attempts: int = 8) -> None:
+        """Sign + submit a config update through the REAL broadcast
+        path, retrying transient failures (leaderless windows,
+        injected `orderer.raft.submit` faults from the background
+        chaos plan)."""
+        last: Optional[Exception] = None
+        for _ in range(attempts):
+            sup = None
+            for o in self.live_orderers():
+                if cid not in o.removed:
+                    sup = o.registrar.get_chain(cid)
+                    break
+            if sup is None:
+                raise RuntimeError(f"no live orderer for {cid}")
+            cur = sup.bundle().config
+            update = compute_update(cid, cur, desired)
+            env = signed_update_envelope(cid, update, list(signers))
+            try:
+                self.pick_broadcast(cid).submit(env)
+                return
+            except Exception as e:         # noqa: BLE001
+                last = e
+                time.sleep(0.25)
+        raise RuntimeError(
+            f"config update on {cid} failed after retries: {last}")
+
+    def _wait_sequence(self, cid: str, seq: int,
+                       timeout_s: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            sups = self.supports(cid)
+            if sups and all(s.bundle().sequence >= seq
+                            for s in sups.values()):
+                return
+            time.sleep(0.05)
+        raise RuntimeError(
+            f"config sequence {seq} did not propagate on {cid}: "
+            f"{[(o, s.bundle().sequence) for o, s in self.supports(cid).items()]}")
+
+    def consenter_ids(self, cid: str) -> List[str]:
+        sups = self.supports(cid)
+        any_sup = next(iter(sups.values()))
+        return list(any_sup.bundle().orderer.consenters())
+
+    def _consenter_update(self, cid: str, new_ids: List[str]) -> None:
+        sup = next(iter(self.supports(cid).values()))
+        cur = sup.bundle().config
+        want_seq = sup.bundle().sequence + 1
+        desired = m.ConfigGroup.decode(cur.channel_group.encode())
+        osec = groups_of(desired)[ORDERER]
+        ctv = values_of(osec)[CONSENSUS_TYPE]
+        ct = m.ConsensusType.decode(ctv.value)
+        ct.metadata = m.RaftMetadata(consenters=list(new_ids)).encode()
+        ctv.value = ct.encode()
+        set_value(osec, CONSENSUS_TYPE, ctv)
+        set_group(desired, ORDERER, osec)
+        self._submit_update(cid, desired, [self.orderer_admin])
+        self._wait_sequence(cid, want_seq)
+
+    def add_consenter(self) -> str:
+        """Admit a NEW consenter on every channel, then boot its
+        replica from genesis — it catches up through the replicated
+        log and becomes a voting member (reference: the raft
+        reconfiguration + onboarding flow)."""
+        with self._lock:
+            new_id = f"o{len(self.orderers)}"
+        for cid in self.channel_ids:
+            self._consenter_update(
+                cid, self.consenter_ids(cid) + [new_id])
+        log.info("soak: consenter %s admitted; booting replica", new_id)
+        self._boot_orderer(new_id)
+        return new_id
+
+    def remove_consenter(self) -> str:
+        """Configure a consenter out on every channel — preferring a
+        DEAD member (the operator repair after a kill), else a live
+        follower (it stays up as a non-voting observer)."""
+        ids0 = self.consenter_ids(self.channel_ids[0])
+        with self._lock:
+            dead = [oid for oid in ids0
+                    if oid in self.orderers and self.orderers[oid].dead]
+        lead = self.leader_of(self.channel_ids[0])
+        candidates = dead or [oid for oid in ids0 if oid != lead]
+        victim = candidates[0]
+        for cid in self.channel_ids:
+            keep = [oid for oid in self.consenter_ids(cid)
+                    if oid != victim]
+            self._consenter_update(cid, keep)
+            with self._lock:
+                if victim in self.orderers:
+                    self.orderers[victim].removed.add(cid)
+        log.info("soak: consenter %s configured out (dead=%s)",
+                 victim, bool(dead))
+        return victim
+
+    def revoke_audit_org(self) -> int:
+        """Remove the audit org from the application group of the
+        event channel: its standing deliver subscription must be cut
+        FORBIDDEN by the mid-stream session re-check.  Returns the
+        peer-ledger height BEFORE the update (the revocation block
+        lands at or after it)."""
+        cid = self.channel_ids[0]
+        pre_h = self.peers[0].height(cid)
+        sup = next(iter(self.supports(cid).values()))
+        want_seq = sup.bundle().sequence + 1
+        desired = m.ConfigGroup.decode(
+            sup.bundle().config.channel_group.encode())
+        app = groups_of(desired)[APPLICATION]
+        app.groups = [e for e in app.groups if e.key != AUDIT_ORG]
+        set_group(desired, APPLICATION, app)
+        # majority of the CURRENT app admins (audit org's own admin
+        # not among the signers — it is being expelled)
+        n_orgs = len(self.orgs) + 1
+        signers = [self.admins[o]
+                   for o in self.orgs[:n_orgs // 2 + 1]]
+        self._submit_update(cid, desired, signers)
+        self._wait_sequence(cid, want_seq)
+        return pre_h
+
+    def set_batch_size(self, cid: str) -> int:
+        """Flip the channel's BatchSize.max_message_count (8 <-> 12):
+        an orderer config update landing under load re-shapes block
+        cutting while txs flow."""
+        sup = next(iter(self.supports(cid).values()))
+        want_seq = sup.bundle().sequence + 1
+        new_count = 12 if self._batch_counts[cid] == 8 else 8
+        desired = m.ConfigGroup.decode(
+            sup.bundle().config.channel_group.encode())
+        osec = groups_of(desired)[ORDERER]
+        bsv = values_of(osec)[BATCH_SIZE]
+        bs = m.BatchSize.decode(bsv.value)
+        bs.max_message_count = new_count
+        bsv.value = bs.encode()
+        set_value(osec, BATCH_SIZE, bsv)
+        set_group(desired, ORDERER, osec)
+        self._submit_update(cid, desired, [self.orderer_admin])
+        self._wait_sequence(cid, want_seq)
+        self._batch_counts[cid] = new_count
+        return new_count
+
+    # -- peers -------------------------------------------------------------
+
+    def add_peer(self) -> SoakPeer:
+        """A peer joining mid-run: fresh ledgers from genesis, gossip
+        join, catch-up via anti-entropy state transfer (the
+        GossipStateProvider.anti_entropy_tick -> node._pull_range path
+        at scale)."""
+        org = self.orgs[len(self.peers) % len(self.orgs)]
+        peer = SoakPeer(self, f"p{len(self.peers)}", org)
+        self.peers.append(peer)
+        for cid in self.channel_ids:
+            eps = [p.nodes[cid].endpoint for p in self.peers]
+            peer.nodes[cid].join(eps)
+            # a couple of membership rounds so existing peers learn
+            # the newcomer (and vice versa) promptly
+            for _ in range(2):
+                for p in self.peers:
+                    p.nodes[cid].discovery.tick_send_alive()
+        peer.start()
+        log.info("soak: peer %s joined (org %s)", peer.name, org)
+        return peer
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._pump = RegisteredThread(target=self._pump_loop,
+                                      name="soak-clock-pump",
+                                      structure="SoakWorld")
+        self._pump.start()
+        for cid in self.channel_ids:
+            for p in self.peers:
+                p.nodes[cid].join(
+                    [q.nodes[cid].endpoint for q in self.peers])
+            for _ in range(2):
+                for p in self.peers:
+                    p.nodes[cid].discovery.tick_send_alive()
+        for p in self.peers:
+            p.start()
+        # the audit org's standing subscription over a REAL socket,
+        # gated by the REAL bundle-backed ACLProvider on p0
+        cid0 = self.channel_ids[0]
+        p0 = self.peers[0]
+        acl = ACLProvider(p0.channels[cid0].bundle)
+        self.event_server = EventDeliverServer(
+            cid0, p0.channels[cid0].ledger, acl)
+        self.event_server.start()
+        self.subscriber = _Subscriber(self.event_server.port, cid0,
+                                      self.audit_client)
+
+    def _pump_loop(self) -> None:
+        while not self._pump_stop.is_set():
+            self.clock.advance(self._clock_step)
+            self._pump_stop.wait(self._clock_interval)
+
+    def orderer_tip(self, cid: str) -> int:
+        return max((s.store.height
+                    for s in self.supports(cid).values()), default=0)
+
+    def close(self) -> None:
+        if self.subscriber is not None:
+            self.subscriber.close()
+        if self.event_server is not None:
+            self.event_server.stop()
+        for p in self.peers:
+            p.stop()
+        self._pump_stop.set()
+        if self._pump is not None:
+            assert_joined((self._pump,), owner="SoakWorld", timeout=5)
+        with self._lock:
+            orderers = list(self.orderers.values())
+        for o in orderers:
+            try:
+                o.registrar.close()
+            except Exception:
+                pass

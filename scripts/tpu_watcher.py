@@ -76,6 +76,11 @@ MATRIX = [
     # (gated vs ungated overload burst + consistency gate)
     ("broadcaststorm", ["--metric", "broadcaststorm", "--batch", "512"],
      {}, 900),
+    # host-only churn soak: a longer on-hardware schedule (12 events)
+    # with the fixed seed — every convergence/exactly-once/leak
+    # invariant gates before the sustained mixed tx/s is recorded
+    ("soak", ["--metric", "soak", "--soak-seed", "8",
+              "--soak-events", "12"], {}, 1200),
 ]
 
 

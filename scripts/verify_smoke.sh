@@ -53,6 +53,18 @@ FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
 FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
     -p no:cacheprovider -p no:randomly -m 'not slow' \
     tests/test_backpressure.py
+# 0d. the soak slice, same permanently-armed FMT_RACECHECK=1 lane: a
+#     short DETERMINISTIC churn-soak (fixed seed 8, ManualClock-
+#     accelerated raft elections, <=60 s) running all six churn-event
+#     kinds — peer join + anti-entropy catch-up, ACL revocation
+#     cutting a live subscriber, batch reconfig, consenter add/remove,
+#     leader kill — under continuous mixed x509+idemix traffic with
+#     the background fault plan armed; fingerprint convergence,
+#     admitted=>committed-exactly-once, and the thread-leak sweep all
+#     gate, and a failure prints the seed + schedule to replay
+FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
+    -p no:cacheprovider -p no:randomly -m 'not slow' \
+    tests/test_soak.py
 # CPU XLA compiles of the verify cores run multiple minutes each (the
 # persistent compile cache is TPU-oriented); give the worker room.
 export FABRIC_MOD_TPU_BENCH_TIMEOUT="${FABRIC_MOD_TPU_BENCH_TIMEOUT:-2400}"

@@ -275,3 +275,121 @@ def test_revoked_subscriber_cut_off_at_config_block(tmp_path):
         grpc_client.close()
         server.stop()
         net.close()
+
+
+def test_real_revocation_cuts_actively_streaming_subscriber_under_load(
+        tmp_path):
+    """The PR 4 mid-stream re-check at system scale: a REAL config
+    update (Org3 removed from the Application group, signed by a
+    majority of admins, through Broadcast -> solo consenter -> deliver
+    -> peer bundle swap) lands while an Org3 subscriber is ACTIVELY
+    receiving blocks under continuous load — the stream must end
+    FORBIDDEN without delivering the revocation block or anything
+    after it, while the load keeps committing for everyone else."""
+    from fabric_mod_tpu.channelconfig import (compute_update,
+                                              signed_update_envelope)
+    from fabric_mod_tpu.channelconfig.bundle import (APPLICATION,
+                                                     groups_of, set_group)
+    from fabric_mod_tpu.soak.harness import _first_config_block_at_or_after
+
+    net = Network(str(tmp_path), batch_timeout="100ms",
+                  max_message_count=4)
+    acl = ACLProvider(net.channel.bundle)
+    server = EventDeliverServer(net.channel_id, net.ledger, acl)
+    server.start()
+    grpc_client = GRPCClient(f"127.0.0.1:{server.port}")
+    stop = threading.Event()
+    pump = net.deliver_client()
+    threads = []
+    try:
+        # continuous load: a submit loop + the deliver pump committing
+        def load():
+            i = 0
+            while not stop.is_set():
+                try:
+                    net.invoke([b"put", b"lk%d" % i, b"lv%d" % i])
+                except Exception:
+                    pass                   # post-revocation churn: retry
+                i += 1
+                time.sleep(0.05)
+
+        threads.append(threading.Thread(target=load, daemon=True))
+        threads.append(threading.Thread(
+            target=lambda: pump.run(idle_timeout_s=30.0), daemon=True))
+        for t in threads:
+            t.start()
+
+        # the Org3 subscriber, streaming FULL blocks from 0
+        cert, key = net.cas["Org3"].issue("sub@org3", "Org3",
+                                          ous=["client"])
+        sub_signer = SigningIdentity("Org3", cert, calib.key_pem(key),
+                                     net.csp)
+        evc = EventDeliverClient(grpc_client, net.channel_id, sub_signer)
+        got, outcome = [], {}
+
+        def subscribe():
+            try:
+                for blk in evc.blocks(start=0, stop=None, timeout_s=90):
+                    got.append(blk.header.number)
+            except EventStreamError as e:
+                outcome["status"] = e.status
+
+        sub = threading.Thread(target=subscribe, daemon=True)
+        sub.start()
+
+        # the subscriber is ACTIVELY streaming: it keeps receiving
+        # new blocks the load commits (not parked at a stale tip)
+        base = len(got)
+        deadline = time.time() + 60
+        while time.time() < deadline and len(got) < base + 3:
+            time.sleep(0.05)
+        assert len(got) >= base + 3, "subscriber never streamed under load"
+
+        # the revocation: remove Org3, signed by the Org1+Org2 admins
+        # (the MAJORITY of the 3 app-org Admins policy)
+        pre_h = net.ledger.height
+        cur = net.support.bundle().config
+        desired = m.ConfigGroup.decode(cur.channel_group.encode())
+        app = groups_of(desired)[APPLICATION]
+        app.groups = [e for e in app.groups if e.key != "Org3"]
+        set_group(desired, APPLICATION, app)
+        update = compute_update(net.channel_id, cur, desired)
+        env = signed_update_envelope(
+            net.channel_id, update,
+            [net.admins["Org1"], net.admins["Org2"]])
+        net.broadcast.submit(env)
+
+        # the revoked stream terminates FORBIDDEN...
+        sub.join(timeout=60)
+        assert not sub.is_alive(), "revoked stream did not terminate"
+        assert outcome.get("status") == m.Status.FORBIDDEN
+        # ...without EVER delivering a post-revocation block
+        deadline = time.time() + 30
+        cfg_num = None
+        while time.time() < deadline and cfg_num is None:
+            cfg_num = _first_config_block_at_or_after(net.ledger, pre_h)
+            time.sleep(0.05)
+        assert cfg_num is not None, "revocation block never committed"
+        late = [n for n in got if n >= cfg_num]
+        assert not late, f"revoked subscriber saw {late} (cfg {cfg_num})"
+
+        # the load is still committing for the surviving orgs: an
+        # Org1 subscriber streams PAST the revocation block
+        h0 = net.ledger.height
+        deadline = time.time() + 60
+        while time.time() < deadline and net.ledger.height <= h0:
+            time.sleep(0.05)
+        assert net.ledger.height > h0, "load stalled after revocation"
+        evc_ok = _events_client(net, grpc_client)
+        nums = [fb.number for fb in
+                evc_ok.filtered_blocks(start=cfg_num,
+                                       stop=net.ledger.height - 1)]
+        assert cfg_num in nums
+    finally:
+        stop.set()
+        pump.stop()
+        for t in threads:
+            t.join(timeout=15)
+        grpc_client.close()
+        server.stop()
+        net.close()

@@ -177,6 +177,14 @@ class ProcNet:
         ], self.oops[oid])
 
     def start_peer(self, pid, org):
+        """Start a peer process; `pid` may be a NEW id (dynamic join
+        after `start_all()`): ports are allocated on demand, so the
+        soak lane and ordinary tests can add peers mid-run and watch
+        them catch up through the deliver path."""
+        if pid not in self.pops:
+            ops, ep = _free_ports(2)
+            self.pops[pid] = ops
+            self.eports[pid] = ep
         orderers = ",".join(f"127.0.0.1:{self.bports[j]}"
                             for j in self.o_ids)
         self._spawn(pid, [
@@ -251,6 +259,33 @@ class ProcNet:
         return _metric_value(
             f"http://127.0.0.1:{self.pops[pid]}/metrics",
             "ledger_blockchain_height")
+
+    def orderer_tip(self):
+        """Max channel height across LIVE orderers (the catch-up
+        target for a late-joining peer)."""
+        tips = []
+        for oid in self.o_ids:
+            if self.procs.get(oid) is None or \
+                    self.procs[oid].poll() is not None:
+                continue
+            try:
+                tips.append(
+                    self.orderer_channels(oid)["channels"][0]["height"])
+            except Exception:
+                pass
+        return max(tips) if tips else 0
+
+    def peer_caught_up(self, pid, t=120.0):
+        """True once `pid`'s committed height reaches the current
+        orderer tip — the late-join catch-up wait (re-evaluated each
+        poll, so a tip that moves during catch-up still gates).  The
+        tip is read ONCE per poll: comparing against one read and
+        guarding on another could pass vacuously when the first read
+        races an election and returns 0."""
+        def ok():
+            tip = self.orderer_tip()
+            return tip > 0 and (self.peer_height(pid) or 0) >= tip
+        return _wait(ok, t=t)
 
     # -- client ------------------------------------------------------------
     def _identity(self, org, kind, name):

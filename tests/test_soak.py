@@ -1,0 +1,207 @@
+"""Sustained soak-under-churn: mixed traffic while membership, config,
+and faults move underneath — fingerprints converge or the run fails.
+
+(reference evaluation model: Jepsen-style invariant checking under a
+nemesis, Basiri et al.'s Chaos Engineering steady-state hypotheses;
+the reference's own integration suites kill orderers and reconfigure
+channels mid-traffic — integration/raft/cft_test.go,
+integration/nwo's channel participation suites.)
+
+Tiers:
+  * seeded IN-PROCESS soak (ManualClock-accelerated raft, real gossip
+    /deliver/commit threads) — the tier-1 acceptance run: >= 5
+    distinct churn-event kinds with every invariant armed;
+  * plan determinism + fail-loud replay contract units;
+  * slow-marked PROCNET lane: the same churn shapes over real OS
+    processes (dynamic peer join via the new ProcNet.start_peer
+    on-demand ports + peer_caught_up, leader SIGKILL) — unaccelerated.
+"""
+import time
+
+import pytest
+
+from fabric_mod_tpu.observability.metrics import default_provider
+from fabric_mod_tpu.soak import (CORE_KINDS, ChurnPlan, InvariantChecker,
+                                 SoakConfig, SoakError, SoakHarness)
+
+SEED = 8          # the fixed tier-1 seed (covers all six event kinds)
+
+
+# --- plan determinism / replay contract ------------------------------------
+
+def test_churn_plan_is_a_pure_function_of_the_seed():
+    a, b = ChurnPlan(SEED, 6), ChurnPlan(SEED, 6)
+    assert a == b and a.events == b.events
+    # the default-size schedule covers the full core catalog
+    assert set(a.kinds()) == set(CORE_KINDS)
+    # different seeds shuffle the schedule (spot-checked pair)
+    assert ChurnPlan(SEED, 6).to_json() != ChurnPlan(SEED + 1, 6).to_json()
+    # a replayed harness regenerates the identical schedule from the
+    # config alone — the failure report's replay contract
+    cfg = SoakConfig(seed=SEED, n_events=6)
+    assert SoakHarness(cfg).plan.to_json() == \
+        SoakHarness(cfg).plan.to_json()
+
+
+def test_plan_never_schedules_quorum_suicide():
+    """No seed may produce a schedule that kills/removes past raft
+    quorum — sweep a band of seeds against the planner's bookkeeping."""
+    for seed in range(50):
+        members, live = 3, 3
+        for ev in ChurnPlan(seed, 8).events:
+            if ev.kind == "leader_kill":
+                live -= 1
+            elif ev.kind == "consenter_add":
+                members += 1
+                live += 1
+            elif ev.kind == "consenter_remove":
+                dead = members - live
+                members -= 1
+                if dead == 0:
+                    live -= 1
+            assert live >= members // 2 + 1, \
+                (seed, ev.kind, members, live)
+
+
+# --- fail-loud: a violated invariant prints seed + schedule ---------------
+
+class _StubLedgerWorld:
+    """Minimal world surface for InvariantChecker: one channel, two
+    peers whose fingerprints DISAGREE at the (stable) tip."""
+
+    class _Sup:
+        class store:
+            height = 3
+
+    class _Peer:
+        def __init__(self, name, fp):
+            self.name, self._fp = name, fp
+
+        def height(self, cid):
+            return 3
+
+        def fingerprint(self, cid):
+            return self._fp
+
+    def __init__(self):
+        self.channel_ids = ["c0"]
+        self.peers = [self._Peer("p0", "aa"), self._Peer("p1", "bb")]
+
+    def supports(self, cid, voting_only=True):
+        return {"o0": self._Sup()}
+
+    def orderer_tip(self, cid):
+        return 3
+
+
+class _StubWorkload:
+    def pause(self, timeout_s=30.0):
+        pass
+
+    def resume(self):
+        pass
+
+
+def test_divergence_fails_loudly_with_seed_and_schedule():
+    plan = ChurnPlan(42, 5)
+    checker = InvariantChecker(_StubLedgerWorld(), _StubWorkload(),
+                               plan, recovery_window_s=3.0)
+    with pytest.raises(SoakError) as ei:
+        checker.check_converged("leader_kill")
+    msg = str(ei.value)
+    assert "DIVERGED" in msg
+    assert "--soak-seed 42" in msg            # the replay command
+    assert plan.to_json() in msg              # the exact schedule
+
+
+# --- the tier-1 acceptance run ---------------------------------------------
+
+def test_soak_under_churn_inprocess():
+    """The seeded in-process soak: 6 distinct churn-event kinds under
+    continuous mixed x509+idemix traffic with the background fault
+    plan armed.  The harness itself enforces the acceptance gates —
+    fingerprint convergence within the recovery window after EVERY
+    event, admitted => committed exactly once (with resubmission of
+    envelopes lost to the leader kill), subscriber cut FORBIDDEN at
+    the revocation block, thread-leak-free teardown — so reaching the
+    report assertions below means every invariant held."""
+    cfg = SoakConfig(seed=SEED, n_events=6, n_channels=2, n_peers=2,
+                     gap_txs=(3, 5), recovery_window_s=60.0)
+    rep = SoakHarness(cfg).run()
+
+    kinds = [e["kind"] for e in rep["events"]]
+    assert len(set(kinds)) >= 5, kinds
+    assert {"peer_join", "acl_revoke", "consenter_add",
+            "consenter_remove", "leader_kill"} <= set(kinds)
+
+    # mixed traffic actually flowed on both lanes, and the whole x509
+    # lane passed the exactly-once ledger audit
+    assert rep["x509_txs"] > 0 and rep["audited_txs"] == rep["x509_txs"]
+    assert rep["idemix_txs"] > 0
+    assert rep["idemix_tamper_rejects"] > 0   # verdict path proven
+    # the background chaos rider fired through the PR 5 seams
+    assert rep["fault_fires"] > 0
+    # the join event grew the fleet and the joiner converged
+    assert rep["peers_final"] == 3
+    # every event recorded a bounded recovery time (the window bounds
+    # how long the checker WAITS; the recorded time may exceed it by
+    # the final settle iteration's own cost — fingerprints over the
+    # whole ledger — so the bound carries that slack)
+    for ev in rep["events"]:
+        assert 0 <= ev["recovery_s"] <= cfg.recovery_window_s + 15, ev
+    # the acl_revoke event proved the mid-stream cutoff
+    revoke = next(e for e in rep["events"] if e["kind"] == "acl_revoke")
+    assert revoke["cut_at_block"] > 0
+    # soak observability on /metrics
+    text = default_provider().render_prometheus()
+    assert "fabric_soak_recovery_seconds" in text
+    assert "fabric_soak_heartbeat" in text
+    assert "fabric_soak_events_total" in text
+
+
+# --- procnet long lane (slow): real processes, unaccelerated ---------------
+
+@pytest.mark.slow
+def test_procnet_soak_churn_lane(tmp_path):
+    """The soak's churn shapes over 5+ real OS processes: traffic,
+    DYNAMIC peer join (ports allocated on demand) + catch-up, leader
+    SIGKILL + re-election, and height convergence across every peer
+    including the late joiner."""
+    from tests.test_procnet import ProcNet, _wait
+
+    net = ProcNet(tmp_path)
+    try:
+        net.start_all()
+        assert _wait(lambda: all(
+            net.orderer_channels(o)["channels"][0]["height"] >= 1
+            for o in net.o_ids), t=150), "orderers did not come up"
+        assert _wait(net.leader_known_by_all, t=150)
+        assert _wait(lambda: all((net.peer_height(p) or 0) >= 1
+                                 for p in ("p0", "p1")), t=150)
+
+        # phase 1: traffic through the leader
+        net.submit_txs(net.leader(), 0, 6)
+        assert _wait(lambda: all((net.peer_height(p) or 0) >= 2
+                                 for p in ("p0", "p1")), t=150)
+
+        # dynamic join AFTER start_all: a third peer with on-demand
+        # ports catches up to the tip through deliver
+        net.start_peer("p2", "Org1")
+        assert net.peer_caught_up("p2", t=180), (
+            f"late joiner stuck at {net.peer_height('p2')} "
+            f"vs tip {net.orderer_tip()}")
+
+        # leader kill under the same run; survivors keep ordering and
+        # ALL peers (joiner included) converge
+        leader = net.leader()
+        net.kill(leader)
+        survivors = [o for o in net.o_ids if o != leader]
+        assert _wait(lambda: net.leader() in survivors, t=240)
+        net.submit_txs(net.leader(), 6, 6)
+        for pid in ("p0", "p1", "p2"):
+            assert net.peer_caught_up(pid, t=240), (
+                pid, net.peer_height(pid), net.orderer_tip())
+        heights = {net.peer_height(p) for p in ("p0", "p1", "p2")}
+        assert len(heights) == 1, heights
+    finally:
+        net.teardown()
