@@ -579,8 +579,11 @@ def measure_commitpipe(n_blocks: int, txs_per_block: int, depth: int,
         pipe.flush()
         dt = time.perf_counter() - t0
         pipe.close()
-        return flags, led.state_fingerprint(), n_txs / dt
+        secs = {"stage": pipe.stage_secs, "await": pipe.await_secs,
+                "commit": pipe.commit_secs}
+        return flags, led.state_fingerprint(), n_txs / dt, secs
 
+    from fabric_mod_tpu.observability import tracing
     with tempfile.TemporaryDirectory(prefix="fmt_commitpipe_") as tmp:
         if not use_sw:
             # warm-up: compile the verify bucket outside the timing
@@ -589,12 +592,31 @@ def measure_commitpipe(n_blocks: int, txs_per_block: int, depth: int,
             Committer(validator, led).store_block(m.Block.decode(blocks[0]))
             log(f"commitpipe warm-up (incl. compile): "
                 f"{time.perf_counter() - t0:.1f}s")
-        sync_flags, sync_fp, sync_rate = run_sync(tmp + "/sync")
-        log(f"sync committer: {sync_rate:,.0f} committed tx/s")
-        pipe_flags, pipe_fp, pipe_rate = run_pipe(tmp + "/pipe", depth)
-        log(f"pipelined (depth={depth}): {pipe_rate:,.0f} committed tx/s "
-            f"({pipe_rate / sync_rate:.2f}x)")
-        d1_flags, d1_fp, _ = run_pipe(tmp + "/depth1", 1)
+        # baseline arms run with tracing EXPLICITLY off: under
+        # --trace-out or an exported FMT_TRACE the whole worker is
+        # armed, and an armed baseline would turn the traced-vs-
+        # untraced identity gate below into armed-vs-armed — vacuous,
+        # and the reported rates would silently include span overhead
+        with tracing.active(False):
+            sync_flags, sync_fp, sync_rate = run_sync(tmp + "/sync")
+            log(f"sync committer: {sync_rate:,.0f} committed tx/s")
+            pipe_flags, pipe_fp, pipe_rate, _secs = run_pipe(
+                tmp + "/pipe", depth)
+            log(f"pipelined (depth={depth}): {pipe_rate:,.0f} "
+                f"committed tx/s ({pipe_rate / sync_rate:.2f}x)")
+            d1_flags, d1_fp, _, _ = run_pipe(tmp + "/depth1", 1)
+        # the TRACED arm: same stream through a pipelined committer
+        # with FMT_TRACE armed — verdicts + state fingerprint must be
+        # IDENTICAL to the tracing-off arms before any attribution
+        # number is reported, and the named sub-span totals must sum
+        # to (within tolerance of) the stage/await/commit buckets the
+        # engine itself measured
+        tracing.recorder().reset()
+        with tracing.active():
+            tr_flags, tr_fp, _tr_rate, tr_secs = run_pipe(
+                tmp + "/traced", depth)
+            totals = {k: v["secs"]
+                      for k, v in tracing.substage_totals().items()}
 
     flags_ok = pipe_flags == sync_flags
     state_ok = pipe_fp == sync_fp
@@ -608,6 +630,34 @@ def measure_commitpipe(n_blocks: int, txs_per_block: int, depth: int,
         raise AssertionError("pipelined state fingerprint diverges")
     if not depth1_ok:
         raise AssertionError("depth=1 does not match the sync path")
+    if tr_flags != sync_flags or tr_fp != sync_fp:
+        raise AssertionError(
+            "FMT_TRACE-armed run diverges from the tracing-off arms "
+            "— tracing must be a pure observer")
+    # stage attribution: the named sub-span totals must explain the
+    # engine's own stage/await/commit buckets (within 10%, floored at
+    # 100 ms so tiny CPU runs don't flake on timer noise)
+    attribution = {
+        "buckets_secs": {k: round(v, 3) for k, v in tr_secs.items()},
+        "substage_secs": {k: round(v, 3) for k, v in sorted(
+            totals.items())},
+    }
+    bucket_parts = {
+        "stage": ("unpack", "device_dispatch"),
+        "await": ("verdict_await",),
+        "commit": ("policy_eval", "mvcc", "ledger_write"),
+    }
+    for bucket, parts in bucket_parts.items():
+        have = sum(totals.get(p, 0.0) for p in parts)
+        want = tr_secs[bucket]
+        tol = max(0.10 * want, 0.1)
+        attribution[f"{bucket}_covered"] = round(
+            have / want, 3) if want > 1e-9 else 1.0
+        if abs(want - have) > tol:
+            raise AssertionError(
+                f"stage attribution drifted: {bucket} bucket "
+                f"{want:.3f}s vs sub-span sum {have:.3f}s "
+                f"({'+'.join(parts)}) — tolerance {tol:.3f}s")
     # the interesting flags actually flipped (the stream exercised the
     # barrier-dependent verdicts, not just all-VALID blocks) — an
     # all-VALID stream would let the differential pass vacuously
@@ -627,6 +677,8 @@ def measure_commitpipe(n_blocks: int, txs_per_block: int, depth: int,
         "flags_identical": flags_ok,
         "state_hash_identical": state_ok,
         "depth1_identical": depth1_ok,
+        "traced_identical": True,          # asserted above
+        "stage_attribution": attribution,
         "verifier": "sw" if use_sw else "device",
     }
 
@@ -672,13 +724,20 @@ def measure_e2e(n_txs: int) -> tuple:
     from fabric_mod_tpu.bccsp.sw import SwCSP
     from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier, TpuVerifier
     from fabric_mod_tpu.e2e import run_pipeline
+    from fabric_mod_tpu.observability import tracing
 
-    sw_rate = run_pipeline(min(n_txs, 2000), FakeBatchVerifier(SwCSP()))
+    # both timed arms run with tracing armed (the warm-up doesn't):
+    # the sub-span totals give the stage-attribution split, and arming
+    # BOTH arms keeps the vs_baseline ratio apples-to-apples
+    with tracing.active():
+        sw_rate = run_pipeline(min(n_txs, 2000),
+                               FakeBatchVerifier(SwCSP()))
     log(f"sw e2e: {sw_rate:,.0f} tx/s")
     verifier = TpuVerifier()
     run_pipeline(min(n_txs, 2000), verifier)      # warm-up/compile
     stats = {}
-    dev_rate = run_pipeline(n_txs, verifier, stats=stats)
+    with tracing.active():
+        dev_rate = run_pipeline(n_txs, verifier, stats=stats)
     log(f"device e2e: {dev_rate:,.0f} tx/s  split: {stats}")
     return dev_rate, sw_rate, stats
 
@@ -1068,13 +1127,18 @@ def measure_soak(seed, n_events) -> dict:
     no thread leaks, throughput recovery) gates BEFORE any rate is
     reported; the JSON carries per-event-kind recovery times and the
     replayable seed + schedule."""
+    from fabric_mod_tpu.observability import tracing
     from fabric_mod_tpu.soak import SoakConfig, SoakHarness
     cfg = SoakConfig(seed=seed, n_events=n_events)
     log(f"soak: seed {cfg.seed}, {cfg.n_events} events, "
         f"{cfg.n_channels} channels, {cfg.n_peers} peers")
     harness = SoakHarness(cfg)
     log(f"soak schedule: {harness.plan.to_json()}")
-    rep = harness.run()
+    # armed: the report carries the run-wide stage attribution, and a
+    # SoakError carries the flight-recorder tail next to its replay
+    # seed + schedule
+    with tracing.active():
+        rep = harness.run()
     log(f"soak: PASS — {rep['x509_txs']} x509 + {rep['idemix_txs']} "
         f"idemix txs over {rep['wall_secs']}s, "
         f"{rep['fault_fires']} background faults fired")
@@ -1173,7 +1237,10 @@ def measure_broadcaststorm(n_txs: int, n_clients: int = 8) -> dict:
 
 
 def run_worker(args) -> int:
-    """The actual measurement; prints the final JSON line on stdout."""
+    """The actual measurement; prints the final JSON line on stdout.
+    With --trace-out, the whole run executes FMT_TRACE-armed and the
+    span ring is exported as Chrome trace-event JSON (Perfetto-
+    loadable; device dispatches as async slices) on the way out."""
     # Under the axon sitecustomize the JAX_PLATFORMS env var alone does
     # NOT disable the TPU plugin (a half-disabled axon hangs); the
     # config update is the reliable switch, and it must happen before
@@ -1182,6 +1249,29 @@ def run_worker(args) -> int:
         import jax
         jax.config.update("jax_platforms", "cpu")
 
+    trace_mod = None
+    if getattr(args, "trace_out", None):
+        from fabric_mod_tpu.observability import tracing as trace_mod
+        trace_mod.enable(True)
+        trace_mod.install_compile_counter()
+    try:
+        return _worker_metric(args)
+    finally:
+        if trace_mod is not None:
+            # best-effort: a bad --trace-out path must not mask the
+            # metric's real result (or failure) from this finally
+            try:
+                d = os.path.dirname(os.path.abspath(args.trace_out))
+                os.makedirs(d, exist_ok=True)
+                n = trace_mod.export_chrome_trace(args.trace_out)
+                log(f"[trace] {n} chrome trace events -> "
+                    f"{args.trace_out} (xla compiles observed: "
+                    f"{trace_mod.compile_count()})")
+            except OSError as e:
+                log(f"[trace] export to {args.trace_out} failed: {e}")
+
+
+def _worker_metric(args) -> int:
     # A/B knobs for the pipelined front-end (all runtime-read env vars,
     # set before any fabric_mod_tpu construction):
     #   --mixed-add    -> affine-table mixed-addition ladder
@@ -1267,6 +1357,8 @@ def run_worker(args) -> int:
             "recovery_s_by_kind": rep["recovery_s_by_kind"],
             "schedule": rep["schedule"],
         }
+        if "stage_attribution" in rep:
+            out["stage_attribution"] = rep["stage_attribution"]
         print(json.dumps(out))
         return 0
     if args.metric == "broadcaststorm":
@@ -1509,6 +1601,8 @@ def supervise(args, argv) -> int:
         # vs_baseline ratio stays honest, the wall-clock stays small
         cpu_argv = ["--batch", str(min(args.batch, 512)), "--reps", "1",
                     "--metric", args.metric]
+        if getattr(args, "trace_out", None):
+            cpu_argv += ["--trace-out", args.trace_out]
         if args.metric == "commitpipe":
             # keep the pipeline shape; drop to the sw backend so the
             # fallback doesn't pay a multi-minute CPU XLA compile
@@ -1580,6 +1674,10 @@ def main() -> int:
     ap.add_argument("--soak-events", type=int, default=None,
                     help="soak: churn events per run (default "
                          "FMT_SOAK_EVENTS or 6)")
+    ap.add_argument("--trace-out", default=None,
+                    help="run FMT_TRACE-armed and export the span "
+                         "ring as Chrome trace-event JSON "
+                         "(Perfetto-loadable) to this path")
     ap.add_argument("--_worker", action="store_true",
                     help=argparse.SUPPRESS)
     args, _ = ap.parse_known_args()
@@ -1602,6 +1700,8 @@ def main() -> int:
             argv += ["--inflight", str(args.inflight)]
         if args.precision is not None:
             argv += ["--precision", args.precision]
+        if args.trace_out is not None:
+            argv += ["--trace-out", args.trace_out]
         if metric == "commitpipe":
             argv += ["--pipeline-depth", str(args.pipeline_depth),
                      "--commitpipe-verifier", args.commitpipe_verifier]

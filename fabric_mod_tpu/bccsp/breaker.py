@@ -27,13 +27,16 @@ open→closed on that clock.
 from __future__ import annotations
 
 import functools
+import itertools
 import threading
 import time
 from typing import Callable, Optional
 
 from fabric_mod_tpu.concurrency import RegisteredLock, RegisteredThread
+from fabric_mod_tpu.observability import tracing
 from fabric_mod_tpu.observability.metrics import (MetricOpts,
                                                   default_provider)
+from fabric_mod_tpu.observability.opsserver import default_health
 from fabric_mod_tpu.utils.env import env_float, env_int
 
 _STATE_OPTS = MetricOpts(
@@ -58,6 +61,10 @@ def _metrics():
     return (prov.gauge(_STATE_OPTS), prov.counter(_OPENS_OPTS),
             prov.histogram(_RECOVERY_OPTS,
                            buckets=(0.1, 1, 5, 15, 60, 300, 1800)))
+
+
+# per-instance health-registry key suffix (breaker names repeat)
+_breaker_seq = itertools.count()
 
 
 def breaker_k(default: int = 3) -> int:
@@ -102,6 +109,19 @@ class CircuitBreaker:
         g_state, self._m_opens, self._m_recovery = _metrics()
         self._g_state = g_state.with_labels(name)
         self._g_state.set(0)
+        # real health: an open circuit (every verify degraded to sw)
+        # flips /healthz.  Keyed per INSTANCE (names repeat — every
+        # TpuVerifier's default breaker is "device-verify", and a
+        # name-shared key would let the newest registration mask an
+        # open circuit elsewhere); stop() unregisters.
+        self._health_key = f"breaker[{name}#{next(_breaker_seq)}]"
+        default_health().register(self._health_key, self._health_check)
+
+    def _health_check(self) -> None:
+        if self._open:
+            raise RuntimeError(
+                f"device-verifier circuit '{self.name}' is OPEN — all "
+                f"verify batches degraded to the sw fallback")
 
     # -- request-path surface ---------------------------------------------
     @property
@@ -134,6 +154,10 @@ class CircuitBreaker:
             # and report an open circuit that is actually closed
             self._g_state.set(1)
         self._m_opens.with_labels(self.name).add(1)
+        # the open IS the incident: snapshot the flight recorder so
+        # the report carries the block timelines that led up to it
+        tracing.note_event("breaker_open", self.name)
+        tracing.auto_dump(f"breaker_open[{self.name}]")
         self._start_prober()
         return True
 
@@ -207,7 +231,9 @@ class CircuitBreaker:
                     return
 
     def stop(self) -> None:
-        """Tear down the prober (owner teardown / test cleanup)."""
+        """Tear down the prober (owner teardown / test cleanup); the
+        health checker leaves the process-default registry with it."""
+        default_health().unregister(self._health_key)
         self._stopped.set()
         self._wake.set()
         with self._lock:

@@ -59,6 +59,7 @@ from fabric_mod_tpu.bccsp import der as _der
 from fabric_mod_tpu.bccsp import sw as _sw
 from fabric_mod_tpu.concurrency import (GuardedQueue, RegisteredLock,
                                         RegisteredThread, assert_joined)
+from fabric_mod_tpu.observability import tracing
 from fabric_mod_tpu.observability.metrics import (MetricOpts,
                                                   default_provider)
 from fabric_mod_tpu.utils.env import env_float as _env_float
@@ -441,20 +442,35 @@ class TpuVerifier:
         device execution."""
         n = len(items)
         size = _bucket(n, self._mesh_size)
-        d, r, s, qx, qy, pre_ok, msg = marshal_items(items, size)
+        with tracing.span("der_marshal", items=n, bucket=size):
+            d, r, s, qx, qy, pre_ok, msg = marshal_items(items, size)
         faults.point("bccsp.device.dispatch")
         from fabric_mod_tpu.ops import p256
+        # opt-in one-shot jax.profiler window (FMT_TRACE armed +
+        # FMT_TRACE_JAX_PROFILE=<dir>): dispatch AND resolve run
+        # inside the capture so the profile contains real device
+        # execution — this batch forfeits its overlap, once, on
+        # purpose (the tpu_watcher matrix trades one batch's latency
+        # for the first on-hardware device profile)
+        capture = tracing.device_profile_capture()
         if msg is not None:
             # fused hash->verify: raw-message lanes hash on device in
             # the SAME program as the ladder — one dispatch, no host
             # digest loop (FABRIC_MOD_TPU_FUSED_HASH consumers)
             words, nblocks, has_msg = msg
-            resolve = p256.batch_verify_raw(
+            dispatch = lambda: p256.batch_verify_raw(
                 words, nblocks, has_msg, d, r, s, qx, qy,
                 mesh=self._mesh, lazy=True)
         else:
-            resolve = p256.batch_verify(d, r, s, qx, qy,
-                                        mesh=self._mesh, lazy=True)
+            dispatch = lambda: p256.batch_verify(d, r, s, qx, qy,
+                                                 mesh=self._mesh,
+                                                 lazy=True)
+        if capture is not None:
+            with capture:
+                mask = dispatch()()
+            resolve = lambda: mask
+        else:
+            resolve = dispatch()
 
         def done() -> np.ndarray:
             faults.point("bccsp.device.resolve")
@@ -636,6 +652,12 @@ class BatchingVerifyService:
 
     def submit(self, item: VerifyItem) -> Future:
         fut: Future = Future()
+        if tracing.armed():
+            # the caller's trace context rides the Future through the
+            # GuardedQueue handoff: the flusher/resolver threads link
+            # their spans under the submitting span, so a tx's trace
+            # survives the batch coalescing seam
+            fut._fmt_trace_ctx = tracing.current_ctx()
         # Under the lock, either close() has not started (the item lands
         # before close()'s straggler drain) or it has finished setting
         # _stop (we reject here) — no orphaned Futures either way.
@@ -743,13 +765,26 @@ class BatchingVerifyService:
         failures surface on the resolver thread."""
         self._batch_hist.observe(len(batch))
         items = [b[0] for b in batch]
+        # stitch the flush span under the FIRST traced submitter (a
+        # coalesced batch has many parents; one link beats none, and
+        # the span's items attr says how many riders shared it)
+        parent = None
+        if tracing.armed():
+            parent = next(
+                (getattr(f, "_fmt_trace_ctx", None) for _, f in batch
+                 if getattr(f, "_fmt_trace_ctx", None) is not None),
+                None)
+        flush_span = tracing.span("verify.flush", parent=parent,
+                                  items=len(batch))
         try:
-            async_fn = getattr(self._verifier, "verify_many_async", None)
-            if async_fn is not None:
-                resolve = async_fn(items)
-            else:
-                mask = self._verifier.verify_many(items)
-                resolve = lambda: mask               # noqa: E731
+            with flush_span:
+                async_fn = getattr(self._verifier,
+                                   "verify_many_async", None)
+                if async_fn is not None:
+                    resolve = async_fn(items)
+                else:
+                    mask = self._verifier.verify_many(items)
+                    resolve = lambda: mask           # noqa: E731
         except Exception as e:
             for _, fut in batch:
                 _complete(fut, exc=e)
@@ -760,7 +795,7 @@ class BatchingVerifyService:
         # while the put blocks, and incrementing after would race the
         # resolver's decrement below zero.
         self._inflight_gauge.add(1)
-        self._inflight.put((batch, resolve))
+        self._inflight.put((batch, resolve, flush_span.ctx))
 
     def _run(self) -> None:
         pending: list[tuple[VerifyItem, Future]] = []
@@ -798,9 +833,14 @@ class BatchingVerifyService:
             got = self._inflight.get()
             if got is self._SENTINEL:
                 return
-            batch, resolve = got
+            batch, resolve, flush_ctx = got
             try:
-                mask = resolve()
+                # the resolve span continues the flush span's trace —
+                # the item's journey submit -> flusher -> device ->
+                # resolver is one stitched parent chain
+                with tracing.span("verify.resolve", parent=flush_ctx,
+                                  items=len(batch)):
+                    mask = resolve()
                 # _complete, not set_result: a deadline-failed
                 # straggler must not kill the resolver thread
                 for (_, fut), ok in zip(batch, mask):

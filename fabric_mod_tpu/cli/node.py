@@ -27,6 +27,7 @@ raft leader, and watches commit resume — the nwo model
 """
 from __future__ import annotations
 
+import itertools
 import os
 import signal
 import threading
@@ -39,14 +40,28 @@ from fabric_mod_tpu.ledger.kvledger import LedgerManager
 from fabric_mod_tpu.msp import ca as calib
 from fabric_mod_tpu.msp.identities import SigningIdentity
 from fabric_mod_tpu.observability import (
-    HealthRegistry, OperationsServer, default_provider, get_logger,
-    init_logging)
+    OperationsServer, default_health, default_provider,
+    get_logger, init_logging)
 from fabric_mod_tpu.orderer import Broadcast, DeliverService, Registrar
 from fabric_mod_tpu.peer.channel import Channel
 from fabric_mod_tpu.peer.deliverclient import DeliverClient
 from fabric_mod_tpu.protos import messages as m
 
 log = get_logger("node")
+
+
+_role_seq = itertools.count()
+
+
+def _register_role_health(health, name, checker):
+    """Per-instance key (name#seq): two roles hosted in one process
+    (embedding, in-process tests) share the process-default registry,
+    and a fixed key would let the second registration mask the
+    first's failing checker — the same masking the commitpipe/breaker
+    registrants key around."""
+    key = f"{name}#{next(_role_seq)}"
+    health.register(key, checker)
+    return key
 
 
 def _load_signer(crypto_dir: str, org: str, kind: str, csp):
@@ -118,9 +133,10 @@ def run_node(genesis_path: str, crypto_dir: str, orderer_org: str,
     if ledger.height == 0:
         channel.init_from_genesis(genesis_block)
 
-    health = HealthRegistry()
-    health.register("ledger", lambda: None if ledger.height > 0 else
-                    (_ for _ in ()).throw(RuntimeError("empty ledger")))
+    health = default_health()
+    _register_role_health(
+        health, "ledger", lambda: None if ledger.height > 0 else
+        (_ for _ in ()).throw(RuntimeError("empty ledger")))
     host, _, port = peer_cfg.ops_listen_address.partition(":")
     # operations TLS (reference: core.yaml operations.tls.*); with a
     # client CA, clients must present certs
@@ -273,8 +289,8 @@ def run_orderer(node_id: str, genesis_path: str, crypto_dir: str,
                            server_key_pem=tls.get("server.key"))
     server.start()
 
-    health = HealthRegistry()
-    health.register("registrar", lambda: None)
+    health = default_health()
+    _register_role_health(health, "registrar", lambda: None)
     from fabric_mod_tpu.orderer.participation import ChannelParticipation
     ops = _start_ops(peer_cfg, health,
                      participation=ChannelParticipation(registrar))
@@ -358,9 +374,10 @@ def run_peer(org: str, genesis_path: str, crypto_dir: str,
     events = EventDeliverServer(cid, ledger, acl, grpc=pserver)
     pserver.start()
 
-    health = HealthRegistry()
-    health.register("ledger", lambda: None if ledger.height > 0 else
-                    (_ for _ in ()).throw(RuntimeError("empty ledger")))
+    health = default_health()
+    _register_role_health(
+        health, "ledger", lambda: None if ledger.height > 0 else
+        (_ for _ in ()).throw(RuntimeError("empty ledger")))
     ops = _start_ops(peer_cfg, health)
     log.info("peer (%s): channel %s at height %d, endorser+events on "
              "port %d, orderers %s, ops on %s", org, cid, ledger.height,

@@ -179,29 +179,34 @@ class GRPCClient:
         else:
             self._channel = grpc.insecure_channel(target, options=opts)
 
+    # `metadata` on each helper: optional [(key, value)] pairs (the
+    # trace-context carrier observability/tracing.inject builds); None
+    # is gRPC's no-metadata, so un-traced callers are byte-identical
+    # to the pre-metadata wire.
+
     def unary(self, service: str, method: str, request: bytes,
-              timeout: Optional[float] = 30.0) -> bytes:
+              timeout: Optional[float] = 30.0, metadata=None) -> bytes:
         fn = self._channel.unary_unary(
             f"/{service}/{method}",
             request_serializer=_IDENT[0],
             response_deserializer=_IDENT[1])
-        return fn(request, timeout=timeout)
+        return fn(request, timeout=timeout, metadata=metadata)
 
     def server_stream(self, service: str, method: str, request: bytes,
-                      timeout: Optional[float] = None):
+                      timeout: Optional[float] = None, metadata=None):
         fn = self._channel.unary_stream(
             f"/{service}/{method}",
             request_serializer=_IDENT[0],
             response_deserializer=_IDENT[1])
-        return fn(request, timeout=timeout)
+        return fn(request, timeout=timeout, metadata=metadata)
 
     def stream_stream(self, service: str, method: str, requests,
-                      timeout: Optional[float] = None):
+                      timeout: Optional[float] = None, metadata=None):
         fn = self._channel.stream_stream(
             f"/{service}/{method}",
             request_serializer=_IDENT[0],
             response_deserializer=_IDENT[1])
-        return fn(requests, timeout=timeout)
+        return fn(requests, timeout=timeout, metadata=metadata)
 
     def close(self) -> None:
         self._channel.close()

@@ -214,6 +214,10 @@ def run_pipeline(n_txs: int, verifier, reps_unused: int = 1,
     (stage_secs = host unpack + device dispatch, commit_secs = verdict
     resolve + MVCC + ledger commit, wall_secs = the measured span) so
     the bench can show how much verify time the double buffer hides."""
+    from fabric_mod_tpu.observability import tracing
+    trace_t0 = ({k: v["secs"]
+                 for k, v in tracing.substage_totals().items()}
+                if tracing.armed() else None)
     with tempfile.TemporaryDirectory() as root:
         net = Network(root, verifier=verifier)
         try:
@@ -265,6 +269,16 @@ def run_pipeline(n_txs: int, verifier, reps_unused: int = 1,
                 # staging (commitpipe's await histogram, summed)
                 stats["await_secs"] = round(client.await_secs, 3)
                 stats["wall_secs"] = round(dt, 3)
+                if trace_t0 is not None:
+                    # FMT_TRACE sub-span split of the buckets above:
+                    # which part of stage/await/commit actually burns
+                    # the wall (recv/unpack/der_marshal/device_
+                    # dispatch/verdict_await/policy_eval/mvcc/
+                    # ledger_write) — the data the next kernel is
+                    # chosen by
+                    stats["stage_attribution"] = {
+                        k: round(v["secs"] - trace_t0.get(k, 0.0), 3)
+                        for k, v in tracing.substage_totals().items()}
             return n_txs / dt
         finally:
             net.close()

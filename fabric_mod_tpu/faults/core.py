@@ -271,6 +271,12 @@ def point(name: str) -> bool:
     if rule is None:
         return False
     _fired_counter().with_labels(name).add(1)
+    # flight-recorder breadcrumb + (rate-limited) auto-dump: a chaos
+    # run's failure report shows WHAT the system was doing around each
+    # injected fault, not just that one fired (FMT_TRACE armed only)
+    from fabric_mod_tpu.observability import tracing
+    tracing.note_event("fault", f"{name} (kind={rule.kind})")
+    tracing.auto_dump(f"fault[{name}]")
     if rule.mode == "error":
         raise rule.make_exception()
     return True

@@ -136,6 +136,13 @@ class GossipService:
                             "%s: deliver client died: %s — restarting "
                             "from committed height",
                             self._node.endpoint, e)
+                        # flight-recorder breadcrumb: a restart storm
+                        # shows up next to the block timelines it
+                        # interleaved with
+                        from fabric_mod_tpu.observability import tracing
+                        tracing.note_event(
+                            "deliver_restart",
+                            f"{self._node.endpoint}: {e!r}")
                         halt.wait(backoff)
                         backoff = min(2.0, backoff * 2)
 

@@ -26,6 +26,7 @@ from typing import Callable, Dict, List, Optional
 
 from fabric_mod_tpu.concurrency import (RegisteredLock,
                                         RegisteredThread, assert_joined)
+from fabric_mod_tpu.observability import tracing
 from fabric_mod_tpu.protos import messages as m
 
 
@@ -164,22 +165,27 @@ class GossipStateProvider:
         n = 0
         with self._drain_lock:
             pipe = self._refresh_pipe()
-            while n < max_blocks:
-                block = self.buffer.pop_in_order()
-                if block is None:
-                    break
-                try:
-                    if pipe is not None:
-                        pipe.submit(block)
-                    else:
-                        self._channel.store_block(block)
-                except Exception:
-                    # the popped block never committed: rewind so it
-                    # stays requestable instead of stalling the
-                    # channel on a permanent invisible gap
-                    self.buffer.resync(self._channel.ledger.height)
-                    raise
-                n += 1
+            # the drain is the gossip->commit seam: its span parents
+            # the engine-side block timelines submitted under it, so a
+            # gossip-fed commit traces back to the drain that fed it
+            with tracing.span("gossip.drain") as drain_span:
+                while n < max_blocks:
+                    block = self.buffer.pop_in_order()
+                    if block is None:
+                        break
+                    try:
+                        if pipe is not None:
+                            pipe.submit(block)
+                        else:
+                            self._channel.store_block(block)
+                    except Exception:
+                        # the popped block never committed: rewind so
+                        # it stays requestable instead of stalling the
+                        # channel on a permanent invisible gap
+                        self.buffer.resync(self._channel.ledger.height)
+                        raise
+                    n += 1
+                drain_span.set(blocks=n)
         return n
 
     def flush(self, timeout_s: Optional[float] = None) -> bool:

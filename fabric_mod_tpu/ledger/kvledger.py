@@ -24,6 +24,7 @@ from fabric_mod_tpu.ledger.blkstorage import BlockStore
 from fabric_mod_tpu.ledger.mvcc import validate_and_prepare_batch
 from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder, parse_tx_rwset
 from fabric_mod_tpu.ledger.statedb import UpdateBatch, VersionedDB
+from fabric_mod_tpu.observability import tracing
 from fabric_mod_tpu.observability.metrics import (
     MetricOpts, default_provider)
 from fabric_mod_tpu.protos import messages as m
@@ -376,36 +377,46 @@ class KvLedger:
                 raise LedgerError(
                     f"flags length {len(incoming_flags)} != "
                     f"{len(envs)} txs")
-            txs = []
-            for env, flag in zip(envs, incoming_flags):
-                try:
-                    ch = protoutil.envelope_channel_header(env)
-                    txid = ch.tx_id
-                except Exception:
-                    txs.append(("", None, m.TxValidationCode.BAD_PAYLOAD))
-                    continue
-                if ch.type != m.HeaderType.ENDORSER_TRANSACTION:
-                    # config/control txs carry no rwset; they commit
-                    # with no state effects (their effect is the bundle
-                    # swap done by the channel machinery upstream)
-                    txs.append((txid, m.TxReadWriteSet(), flag))
-                else:
-                    txs.append((txid, tx_rwset_from_envelope(env), flag))
-            with H_STATE_VALIDATION.time():
-                flags, batch, tx_writes = validate_and_prepare_batch(
-                    txs, self.state, num)
+            # "mvcc" covers the commit-side host unpack (rwset
+            # extraction) + the version compares — together the
+            # conflict-detection cost the vectorized-MVCC roadmap
+            # item targets
+            with tracing.span("mvcc", block=num):
+                txs = []
+                for env, flag in zip(envs, incoming_flags):
+                    try:
+                        ch = protoutil.envelope_channel_header(env)
+                        txid = ch.tx_id
+                    except Exception:
+                        txs.append(
+                            ("", None, m.TxValidationCode.BAD_PAYLOAD))
+                        continue
+                    if ch.type != m.HeaderType.ENDORSER_TRANSACTION:
+                        # config/control txs carry no rwset; they
+                        # commit with no state effects (their effect is
+                        # the bundle swap done by the channel machinery
+                        # upstream)
+                        txs.append((txid, m.TxReadWriteSet(), flag))
+                    else:
+                        txs.append(
+                            (txid, tx_rwset_from_envelope(env), flag))
+                with H_STATE_VALIDATION.time():
+                    flags, batch, tx_writes = validate_and_prepare_batch(
+                        txs, self.state, num)
             protoutil.set_block_txflags(block, bytes(flags))
-            with H_BLOCK_COMMIT.time():
-                self.blockstore.add_block(block)
-            with H_STATE_COMMIT.time():
-                self.state.apply_updates(batch, num)
-                # per-tx writes (not the deduped batch) so commit and
-                # recovery replay record identical history
-                self.history.commit(num, tx_writes)
-                self._commit_pvt(num, txs, flags)
-                self.confighistory.handle_block_writes(
-                    num, [(ns, key, value) for (ns, key), (value, _v)
-                          in batch.updates.items()])
+            with tracing.span("ledger_write", block=num):
+                with H_BLOCK_COMMIT.time():
+                    self.blockstore.add_block(block)
+                with H_STATE_COMMIT.time():
+                    self.state.apply_updates(batch, num)
+                    # per-tx writes (not the deduped batch) so commit
+                    # and recovery replay record identical history
+                    self.history.commit(num, tx_writes)
+                    self._commit_pvt(num, txs, flags)
+                    self.confighistory.handle_block_writes(
+                        num, [(ns, key, value)
+                              for (ns, key), (value, _v)
+                              in batch.updates.items()])
             G_HEIGHT.with_labels(self.ledger_id).set(
                 self.blockstore.height)
             if not self._durable and (num + 1) % self.SNAPSHOT_EVERY == 0:
@@ -619,8 +630,9 @@ class KvLedger:
         soak harness's convergence checker hit exactly this on the
         freshest block of whichever peer committed last)."""
         import hashlib
-        with self._lock:
-            return self._state_fingerprint_locked(hashlib.sha256())
+        with tracing.span("fingerprint", channel=self.ledger_id):
+            with self._lock:
+                return self._state_fingerprint_locked(hashlib.sha256())
 
     def _state_fingerprint_locked(self, h) -> str:
         h.update(self.height.to_bytes(8, "big"))

@@ -1,9 +1,12 @@
-"""Observability: metrics, health, logging, ops HTTP server
-(reference: common/metrics, common/flogging, core/operations)."""
+"""Observability: metrics, health, logging, tracing, ops HTTP server
+(reference: common/metrics, common/flogging, core/operations; the
+tracing/flight-recorder layer is this repo's Dapper-style addition —
+observability/tracing.py)."""
 from fabric_mod_tpu.observability.metrics import (      # noqa: F401
     Counter, Gauge, Histogram, MetricOpts, MetricsProvider,
     default_provider)
 from fabric_mod_tpu.observability.logging import (      # noqa: F401
     activate_spec, get_logger, init_logging)
 from fabric_mod_tpu.observability.opsserver import (    # noqa: F401
-    HealthRegistry, OperationsServer)
+    HealthRegistry, OperationsServer, default_health)
+from fabric_mod_tpu.observability import tracing        # noqa: F401

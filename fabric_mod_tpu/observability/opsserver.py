@@ -32,6 +32,10 @@ class HealthRegistry:
         with self._lock:
             self._checkers[name] = checker
 
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._checkers.pop(name, None)
+
     def status(self):
         failures = {}
         with self._lock:
@@ -42,6 +46,24 @@ class HealthRegistry:
             except Exception as e:
                 failures[name] = str(e)
         return ("OK" if not failures else "Service Unavailable", failures)
+
+
+_default_health: Optional[HealthRegistry] = None
+_default_health_lock = threading.Lock()
+
+
+def default_health() -> HealthRegistry:
+    """The process-default checker registry.  Long-lived components
+    (circuit breakers, commit pipelines, the soak heartbeat) register
+    themselves here at construction, so any OperationsServer built
+    without an explicit registry serves REAL health — the reference's
+    pattern where subsystems feed the healthz registry the ops
+    listener was built with."""
+    global _default_health
+    with _default_health_lock:
+        if _default_health is None:
+            _default_health = HealthRegistry()
+        return _default_health
 
 
 class OperationsServer:
@@ -56,7 +78,7 @@ class OperationsServer:
         channel storage, so expose it off-loopback ONLY behind
         client-authenticated TLS."""
         self.provider = provider or default_provider()
-        self.health = health or HealthRegistry()
+        self.health = health or default_health()
         # orderer-only: the channel participation API rides the ops
         # listener (reference: restapi.go mounted on the admin server)
         self.participation = participation
@@ -99,10 +121,14 @@ class OperationsServer:
                     from fabric_mod_tpu.observability.diag import (
                         dump_threads)
                     self._send(200, dump_threads().encode())
-                elif self.path.startswith("/debug/pprof"):
+                elif self.path.startswith(("/debug/pprof",
+                                           "/debug/profile")):
                     # sampling CPU profile, collapsed-stack text
                     # (reference: the pprof endpoints of the
-                    # operations server); ?seconds=N bounds the run
+                    # operations server); ?seconds=N bounds the run.
+                    # /debug/profile is the documented alias — a
+                    # wedged soak run is profiled over HTTP, no
+                    # SIGUSR1 shell access needed.
                     from urllib.parse import parse_qs, urlparse
                     from fabric_mod_tpu.observability.diag import (
                         sample_profile)
@@ -114,6 +140,30 @@ class OperationsServer:
                         self._send(400, b"bad seconds parameter")
                         return
                     self._send(200, sample_profile(secs).encode())
+                elif self.path.startswith("/trace"):
+                    # recent finished spans (FMT_TRACE armed), newest
+                    # last; ?trace_id= filters one stitched trace,
+                    # ?limit= bounds the answer
+                    from urllib.parse import parse_qs, urlparse
+                    from fabric_mod_tpu.observability import tracing
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        limit = int((q.get("limit") or ["512"])[0])
+                    except ValueError:
+                        limit = 512
+                    tid = (q.get("trace_id") or [None])[0]
+                    self._send(200, json.dumps(
+                        {"armed": tracing.armed(),
+                         "spans": tracing.recorder().recent_spans(
+                             trace_id=tid, limit=limit)}).encode(),
+                        "application/json")
+                elif self.path == "/flight":
+                    # the flight recorder: recent block timelines +
+                    # events + auto-dumps + cumulative sub-stage totals
+                    from fabric_mod_tpu.observability import tracing
+                    self._send(200,
+                               json.dumps(tracing.flight_dump()).encode(),
+                               "application/json")
                 elif self.path.startswith("/participation/"):
                     self._participation("GET")
                 else:

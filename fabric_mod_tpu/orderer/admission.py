@@ -195,8 +195,12 @@ def shed(reason: str, msg: str,
          retry_after_s: float = 0.25) -> ResourceExhaustedError:
     """Count one shed and build the typed answer (callers raise it).
     Centralized so every shed — controller or chain queue — lands in
-    the same counter."""
+    the same counter (and, FMT_TRACE armed, the flight recorder's
+    event tape: overload sheds show up next to the block timelines
+    they interleaved with)."""
     _metrics()["sheds"].with_labels(reason).add(1)
+    from fabric_mod_tpu.observability import tracing
+    tracing.note_event("admission_shed", reason)
     return ResourceExhaustedError(msg, reason=reason,
                                   retry_after_s=retry_after_s)
 

@@ -32,6 +32,7 @@ import time
 from typing import Optional
 
 from fabric_mod_tpu.channelconfig import ConfigTxError
+from fabric_mod_tpu.observability import tracing
 from fabric_mod_tpu.orderer import admission as admission_mod
 from fabric_mod_tpu.orderer.consensus import NotLeaderError
 from fabric_mod_tpu.orderer.msgprocessor import MsgRejectedError
@@ -89,6 +90,10 @@ class Broadcast:
         client's cue to back off or follow the leader hint), and
         admission_mod.ResourceExhaustedError when admission sheds the
         submission (maps to RESOURCE_EXHAUSTED + retry-after)."""
+        with tracing.span("broadcast.submit"):
+            self._submit_traced(env)
+
+    def _submit_traced(self, env: m.Envelope) -> None:
         adm = self._admission
         t0 = time.perf_counter() if adm is not None else 0.0
         try:

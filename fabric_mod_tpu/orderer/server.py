@@ -53,10 +53,20 @@ class OrdererServer:
 
     # -- Broadcast stream (reference: broadcast.go:66) -------------------
     def _handle_broadcast(self, request_iter, context) -> Iterator[bytes]:
+        # cross-process trace stitching: the broadcast client carries
+        # its trace context as stream metadata (tracing.inject on the
+        # GrpcBroadcaster side); every envelope handled on this stream
+        # parents under it, so a procnet tx is ONE trace from client
+        # submit through the orderer's admission + ordering
+        from fabric_mod_tpu.observability import tracing
+        parent = tracing.extract(context.invocation_metadata()) \
+            if tracing.armed() else None
         for raw in request_iter:
             try:
                 env = m.Envelope.decode(raw)
-                self._broadcast.submit(env)
+                with tracing.span("broadcast.handle", parent=parent,
+                                  bytes=len(raw)):
+                    self._broadcast.submit(env)
                 resp = m.BroadcastResponse(status=m.Status.SUCCESS)
             except BroadcastError as e:
                 resp = m.BroadcastResponse(

@@ -47,6 +47,7 @@ Every stage is instrumented (MetricsProvider -> opsserver /metrics):
 from __future__ import annotations
 
 import functools
+import itertools
 import os
 import threading
 import time
@@ -56,8 +57,10 @@ from fabric_mod_tpu import faults
 from fabric_mod_tpu.concurrency import (GuardedQueue, OwnedState,
                                         RegisteredLock,
                                         RegisteredThread, assert_joined)
+from fabric_mod_tpu.observability import tracing
 from fabric_mod_tpu.observability.metrics import (MetricOpts,
                                                   default_provider)
+from fabric_mod_tpu.observability.opsserver import default_health
 
 _STAGE_OPTS = MetricOpts(
     "fabric", "commitpipe", "stage_seconds",
@@ -95,6 +98,10 @@ def _metrics():
             prov.gauge(_OCCUPANCY_OPTS),
             prov.counter(_BARRIER_OPTS),
             prov.counter(_BLOCKS_OPTS))
+
+
+# per-instance health-registry key suffix (consumer labels repeat)
+_pipe_seq = itertools.count()
 
 
 def pipeline_depth(default: int = 0) -> int:
@@ -197,6 +204,22 @@ class PipelinedCommitter:
         (self._m_stage, self._m_await, self._m_commit,
          occupancy, self._m_barriers, self._m_blocks) = _metrics()
         self._m_occupancy = occupancy.with_labels(consumer)
+        self._consumer = consumer
+        # real health: a poisoned (sticky-error, not yet discarded)
+        # pipeline flips /healthz — the registry existed since the ops
+        # server landed, this is the first commit-path registrant.
+        # Keyed per INSTANCE (consumer labels repeat: every channel's
+        # engine is consumer="channel" — a shared key would let the
+        # newest registration mask another channel's poisoned pipe);
+        # close() unregisters, so the registry tracks live pipes only.
+        self._health_key = f"commitpipe[{consumer}#{next(_pipe_seq)}]"
+        default_health().register(self._health_key, self._health_check)
+
+    def _health_check(self) -> None:
+        if self._err is not None and not self._closed:
+            raise RuntimeError(
+                f"commit pipeline [{self._consumer}] poisoned: "
+                f"{self._err!r}")
 
     # -- timing surface (kept: bench/deliver-client read these) -----------
     @property
@@ -334,6 +357,11 @@ class PipelinedCommitter:
                     return
                 self._closed = True
             started = self._started
+        # a closed (drained or discarded) engine leaves the health
+        # registry: its sticky error was surfaced to its callers, and
+        # keeping the entry would pin the whole channel/ledger graph
+        # in the process-global registry forever
+        default_health().unregister(self._health_key)
         if not started:
             return
         self._in_q.put(None)
@@ -374,7 +402,17 @@ class PipelinedCommitter:
                 # under test — a poisoned pipe must fail its callers
                 # and be rebuildable from the committed height)
                 faults.point("commitpipe.stage")
-                staged = self._channel.stage_block(block)
+                # one flight-recorder timeline per block: the stage
+                # side's sub-spans (unpack, device_dispatch) land
+                # here; StagedBlock carries it across the handoff and
+                # the commit loop resumes it (None when FMT_TRACE is
+                # unset — zero objects, zero writes)
+                tl = tracing.start_timeline(self._consumer,
+                                            block.header.number)
+                with tracing.timeline_scope(tl):
+                    staged = self._channel.stage_block(block)
+                if tl is not None:
+                    staged.trace_timeline = tl
                 dt = time.perf_counter() - t0
                 self._stage_state.secs += dt
                 self._m_stage.observe(dt)
@@ -397,6 +435,7 @@ class PipelinedCommitter:
             staged = self._staged_q.get()
             if staged is None:
                 return
+            tl = getattr(staged, "trace_timeline", None)
             try:
                 # chaos seam: a crash between verdict await and ledger
                 # write — the worst spot: the block is staged, its
@@ -404,21 +443,24 @@ class PipelinedCommitter:
                 # the ledger (crash-resume must re-commit it exactly
                 # once from the durable height)
                 faults.point("commitpipe.commit")
-                t0 = time.perf_counter()
-                staged.resolve_mask()      # the device-verdict wait
-                dt = time.perf_counter() - t0
-                self._commit_state.await_secs += dt
-                self._m_await.observe(dt)
-                t0 = time.perf_counter()
-                flags = self._channel.commit_staged(staged)
-                dt = time.perf_counter() - t0
-                self._commit_state.commit_secs += dt
-                self._m_commit.observe(dt)
+                with tracing.timeline_scope(tl):
+                    t0 = time.perf_counter()
+                    staged.resolve_mask()  # the device-verdict wait
+                    dt = time.perf_counter() - t0
+                    self._commit_state.await_secs += dt
+                    self._m_await.observe(dt)
+                    t0 = time.perf_counter()
+                    flags = self._channel.commit_staged(staged)
+                    dt = time.perf_counter() - t0
+                    self._commit_state.commit_secs += dt
+                    self._m_commit.observe(dt)
             except Exception as e:
                 self._fail(e)
                 while self._staged_q.get() is not None:
                     pass
                 return
+            finally:
+                tracing.finish_timeline(tl)
             with self._cv:
                 self._inflight -= 1
                 self._m_occupancy.set(self._inflight)

@@ -34,6 +34,7 @@ import threading
 from typing import Callable, List, Optional
 
 from fabric_mod_tpu.concurrency import OwnedState
+from fabric_mod_tpu.observability import tracing
 from fabric_mod_tpu.peer.channel import Channel
 from fabric_mod_tpu.peer.commitpipe import PipelinedCommitter, pipeline_depth
 from fabric_mod_tpu.peer.mcs import BlockVerificationError
@@ -173,41 +174,48 @@ class DeliverClient:
                 start, stop=stop_at, stop_event=self._stop,
                 timeout_s=idle_timeout_s))
             while True:
-                try:
-                    block = next(source_iter)
-                except StopIteration:
-                    break                  # clean end / idle timeout
-                except Exception as e:
-                    # dropped stream, single-endpoint mode: surface a
-                    # TYPED error with the resume point, not a bare
-                    # transport exception (a failover source handles
-                    # this internally and never raises here).  Raised
-                    # AFTER the finally drains the pipe, so the
-                    # carried height includes every in-flight commit —
-                    # it IS the next run()'s re-seek point.
-                    dropped = e
-                    break
-                if self._stop.is_set():
-                    break
-                try:
-                    self._channel.mcs.verify_block(
-                        self._channel.channel_id, block,
-                        expected_prev_hash=prev_hash)
-                except BlockVerificationError:
-                    # tampered/mis-signed block: drop it, never commit.
-                    # With a failover source, ask it to re-fetch this
-                    # block from a DIFFERENT orderer and keep pulling
-                    # (reference: blocksprovider.go:227 — disconnect
-                    # and retry another orderer); a single-endpoint
-                    # source fails closed by stopping.
-                    self.rejected.append(block.header.number)
-                    del self.rejected[:-1000]      # bounded memory
-                    report = getattr(self._source, "report_bad_block",
-                                     None)
-                    if report is not None:
-                        report(block.header.number)
-                        continue
-                    break
+                # "recv" attributes stage 1: the pull wait + the MCS
+                # hash/signature check, per block (the part of the
+                # wall the commit pipeline can never hide)
+                with tracing.span("recv") as recv_span:
+                    try:
+                        block = next(source_iter)
+                    except StopIteration:
+                        break              # clean end / idle timeout
+                    except Exception as e:
+                        # dropped stream, single-endpoint mode:
+                        # surface a TYPED error with the resume point,
+                        # not a bare transport exception (a failover
+                        # source handles this internally and never
+                        # raises here).  Raised AFTER the finally
+                        # drains the pipe, so the carried height
+                        # includes every in-flight commit — it IS the
+                        # next run()'s re-seek point.
+                        dropped = e
+                        break
+                    if self._stop.is_set():
+                        break
+                    recv_span.set(block=block.header.number)
+                    try:
+                        self._channel.mcs.verify_block(
+                            self._channel.channel_id, block,
+                            expected_prev_hash=prev_hash)
+                    except BlockVerificationError:
+                        # tampered/mis-signed block: drop it, never
+                        # commit.  With a failover source, ask it to
+                        # re-fetch this block from a DIFFERENT orderer
+                        # and keep pulling (reference:
+                        # blocksprovider.go:227 — disconnect and retry
+                        # another orderer); a single-endpoint source
+                        # fails closed by stopping.
+                        self.rejected.append(block.header.number)
+                        del self.rejected[:-1000]  # bounded memory
+                        report = getattr(self._source,
+                                         "report_bad_block", None)
+                        if report is not None:
+                            report(block.header.number)
+                            continue
+                        break
                 prev_hash = protoutil.block_header_hash(block.header)
                 try:
                     self._pipe.submit(block)

@@ -17,6 +17,7 @@ redirect-following — the hint has been on the wire since PR 5).
 """
 from __future__ import annotations
 
+import os
 import queue
 import re
 import threading
@@ -137,14 +138,40 @@ class GrpcBroadcaster:
         self._lock = threading.Lock()
         self._owned: list = []             # redirect-dialed clients
         self._hint_wait = 0.0              # pending retry-after hint
+        self.trace_ctx = None              # set when FMT_TRACE is armed
         self._open(client)
 
     def _open(self, client: GRPCClient) -> None:
+        from fabric_mod_tpu.observability import tracing
         self._client = client
         self._q: "queue.Queue[Optional[bytes]]" = queue.Queue(
             maxsize=self._queue_cap)
+        # cross-process stitching: when FMT_TRACE is armed, the
+        # stream's invocation metadata carries this client's trace
+        # context — the orderer's broadcast handler parents its spans
+        # under it, so a tx is ONE trace across the process boundary.
+        # Unarmed, inject() is None and the wire is byte-identical.
+        self._trace_md = tracing.inject(self._trace_root())
+        # keyword passed ONLY when armed: scripted/fake clients that
+        # predate the metadata parameter keep working untraced
+        kw = {"metadata": self._trace_md} \
+            if self._trace_md is not None else {}
         self._resps = client.stream_stream(
-            SERVICE, "Broadcast", iter(self._q.get, None))
+            SERVICE, "Broadcast", iter(self._q.get, None), **kw)
+
+    def _trace_root(self):
+        """The stream's carrier context: the caller's current span if
+        one is live, else a fresh per-stream root so even an
+        un-spanned client gets a stitched trace id."""
+        from fabric_mod_tpu.observability import tracing
+        if not tracing.armed():
+            return None
+        ctx = tracing.current_ctx()
+        if ctx is None:
+            ctx = tracing.TraceContext(tracing.new_trace_id(),
+                                       os.urandom(4).hex())
+        self.trace_ctx = ctx
+        return ctx
 
     def _reconnect(self, client: GRPCClient) -> None:
         """Swap streams (caller holds the lock): end the old stream;

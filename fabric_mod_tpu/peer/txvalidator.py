@@ -31,6 +31,7 @@ import functools
 
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from fabric_mod_tpu.observability import tracing
 from fabric_mod_tpu.observability.metrics import (MetricOpts,
                                                   default_provider)
 from fabric_mod_tpu.policy import ApplicationPolicyEvaluator, BatchCollector
@@ -133,9 +134,15 @@ class _TxWork:
 
 class StagedBlock:
     """A block after passes 1+2: host staging done, device batch
-    dispatched, verdicts pending (resolved by TxValidator.finish)."""
+    dispatched, verdicts pending (resolved by TxValidator.finish).
 
-    __slots__ = ("block", "validator", "works", "mask_fn", "_mask")
+    `trace_timeline` (FMT_TRACE armed only, else None) is the block's
+    flight-recorder timeline riding the stage→commit handoff: the
+    engine that staged this block attaches it, the committing side
+    resumes it — context propagation by carrying the context."""
+
+    __slots__ = ("block", "validator", "works", "mask_fn", "_mask",
+                 "trace_timeline")
 
     def __init__(self, block, validator, works, mask_fn):
         self.block = block
@@ -143,13 +150,19 @@ class StagedBlock:
         self.works = works
         self.mask_fn = mask_fn
         self._mask = None
+        self.trace_timeline = None
 
     def resolve_mask(self):
         """Await the device verdicts (idempotent).  The commit
         pipeline calls this under its own await-latency histogram;
         `finish` then reads the cached mask for free."""
         if self._mask is None:
-            self._mask = self.mask_fn()
+            # the single choke point both the pipelined and the
+            # synchronous path pass through — the verdict_await
+            # sub-stage is attributed HERE so neither path can hide it
+            with tracing.span("verdict_await",
+                              block=self.block.header.number):
+                self._mask = self.mask_fn()
         return self._mask
 
     @property
@@ -377,17 +390,19 @@ class TxValidator:
         # VALIDATION_PARAMETER writes of EARLIER txs in this block —
         # the intra-block dependency structure of validator_keylevel.go
         inblock_vp: Dict[tuple, list] = {}
-        for idx, data in enumerate(block.data.data):
-            work = _TxWork()
-            works.append(work)
-            try:
-                env = m.Envelope.decode(data)
-            except Exception:
-                work.flag = V.BAD_PAYLOAD
-                continue
-            self._stage_tx(env, work, collector, inblock_vp)
-            for ns, key, vp in work.vp_writes:
-                inblock_vp.setdefault((ns, key), []).append((idx, vp))
+        with tracing.span("unpack", block=block.header.number,
+                          txs=len(block.data.data)):
+            for idx, data in enumerate(block.data.data):
+                work = _TxWork()
+                works.append(work)
+                try:
+                    env = m.Envelope.decode(data)
+                except Exception:
+                    work.flag = V.BAD_PAYLOAD
+                    continue
+                self._stage_tx(env, work, collector, inblock_vp)
+                for ns, key, vp in work.vp_writes:
+                    inblock_vp.setdefault((ns, key), []).append((idx, vp))
 
         # pass 2: dispatch the device batch (async when the verifier
         # supports it; the resolver blocks only when called).  Repeats
@@ -405,12 +420,15 @@ class TxValidator:
         # observable per block.
         raw_ctr.add(sum(1 for it in collector.items
                         if getattr(it, "message", None) is not None))
-        async_fn = getattr(self._verifier, "verify_many_async", None)
-        if async_fn is not None:
-            mask_fn = async_fn(collector.items)
-        else:
-            items = collector.items
-            mask_fn = lambda: self._verifier.verify_many(items)
+        with tracing.span("device_dispatch",
+                          block=block.header.number,
+                          items=len(collector.items)):
+            async_fn = getattr(self._verifier, "verify_many_async", None)
+            if async_fn is not None:
+                mask_fn = async_fn(collector.items)
+            else:
+                items = collector.items
+                mask_fn = lambda: self._verifier.verify_many(items)
         return StagedBlock(block, self, works, mask_fn)
 
     def finish(self, staged: "StagedBlock") -> List[int]:
@@ -423,19 +441,20 @@ class TxValidator:
         flags: List[int] = []
         seen_txids = set()
         applied_vp: Dict[tuple, int] = {}   # (ns, key) -> writer tx_idx
-        for idx, work in enumerate(works):
-            flag = self._finish_tx(work, mask, applied_vp)
-            if flag == V.VALID and work.txid:
-                if work.txid in seen_txids or \
-                        self._tx_id_exists(work.txid):
-                    flag = V.DUPLICATE_TXID
-                else:
-                    seen_txids.add(work.txid)
-            if flag == V.VALID:
-                for ns, key, _vp in work.vp_writes:
-                    applied_vp[(ns, key)] = idx
-            flags.append(flag)
-        protoutil.set_block_txflags(block, bytes(flags))
+        with tracing.span("policy_eval", block=block.header.number):
+            for idx, work in enumerate(works):
+                flag = self._finish_tx(work, mask, applied_vp)
+                if flag == V.VALID and work.txid:
+                    if work.txid in seen_txids or \
+                            self._tx_id_exists(work.txid):
+                        flag = V.DUPLICATE_TXID
+                    else:
+                        seen_txids.add(work.txid)
+                if flag == V.VALID:
+                    for ns, key, _vp in work.vp_writes:
+                        applied_vp[(ns, key)] = idx
+                flags.append(flag)
+            protoutil.set_block_txflags(block, bytes(flags))
         return flags
 
     def validate(self, block: m.Block) -> List[int]:
@@ -499,5 +518,13 @@ class Committer:
         self.ledger = ledger
 
     def store_block(self, block: m.Block) -> List[int]:
-        flags = self.validator.validate(block)
-        return self.ledger.commit_block(block, flags)
+        # the synchronous path records the SAME per-block timeline the
+        # pipelined engine does, so /flight and the bench attribution
+        # see both arms through one lens
+        tl = tracing.start_timeline("sync", block.header.number)
+        try:
+            with tracing.timeline_scope(tl):
+                flags = self.validator.validate(block)
+                return self.ledger.commit_block(block, flags)
+        finally:
+            tracing.finish_timeline(tl)

@@ -246,8 +246,12 @@ class SoakHarness:
             return self._run_in(root)
 
     def _run_in(self, root: str) -> Dict:
+        from fabric_mod_tpu.observability import tracing
         cfg = self.cfg
         t_start = time.monotonic()
+        trace_t0 = ({k: v["secs"]
+                     for k, v in tracing.substage_totals().items()}
+                    if tracing.armed() else None)
         world = SoakWorld(root, cfg.seed, n_channels=cfg.n_channels,
                           n_peers=cfg.n_peers)
         workload = MixedWorkload(world, x509_gap_s=cfg.x509_gap_s,
@@ -306,6 +310,7 @@ class SoakHarness:
             except Exception:
                 pass
             world.close()
+            checker.close_health()
         checker.check_thread_leaks()
         wall = time.monotonic() - t_start
         counts = workload.counts()
@@ -330,6 +335,12 @@ class SoakHarness:
             "peers_final": len(world.peers),
             "channels": world.channel_ids,
         }
+        if trace_t0 is not None:
+            # commit-path stage attribution across the whole run (the
+            # FMT_TRACE sub-span totals accumulated since t_start)
+            report["stage_attribution"] = {
+                k: round(v["secs"] - trace_t0.get(k, 0.0), 3)
+                for k, v in tracing.substage_totals().items()}
         log.info("soak: PASS %s", report)
         return report
 

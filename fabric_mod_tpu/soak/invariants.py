@@ -50,11 +50,18 @@ _HEARTBEAT = default_provider().gauge(MetricOpts(
 
 class SoakError(AssertionError):
     """A violated soak invariant.  The message always embeds the seed
-    and the full event schedule (the replay contract)."""
+    and the full event schedule (the replay contract) — and, with
+    FMT_TRACE armed, the flight-recorder tail: the last block
+    timelines and events around the failure, so the report says what
+    the system was DOING, not just which invariant broke."""
 
     def __init__(self, msg: str, plan=None):
         if plan is not None:
             msg = f"{msg}\n{plan.describe()}"
+        from fabric_mod_tpu.observability import tracing
+        if tracing.armed():
+            tracing.auto_dump("soak_error")
+            msg = f"{msg}\n{tracing.flight_text()}"
         super().__init__(msg)
 
 
@@ -75,8 +82,29 @@ class InvariantChecker:
         # shares a name with one alive at construction (strong refs,
         # so a recycled id() can never alias a baseline entry)
         self._thread_baseline = set(live_registered())
+        # real health: a soak whose heartbeat goes stale (no event
+        # completed for 2 recovery windows) flips /healthz so a
+        # wedged long run is visible from outside the process
+        self._last_beat_wall = time.monotonic()
+        from fabric_mod_tpu.observability.opsserver import default_health
+        default_health().register("soak-heartbeat", self._health_check)
+
+    def _health_check(self) -> None:
+        stale = time.monotonic() - self._last_beat_wall
+        budget = max(2 * self.window_s, 90.0)
+        if stale > budget:
+            raise RuntimeError(
+                f"soak heartbeat stale: {stale:.0f}s since the last "
+                f"completed event (budget {budget:.0f}s)")
+
+    def close_health(self) -> None:
+        """Drop the heartbeat checker (harness teardown — a finished
+        soak must not leave /healthz reporting staleness forever)."""
+        from fabric_mod_tpu.observability.opsserver import default_health
+        default_health().unregister("soak-heartbeat")
 
     def beat(self) -> None:
+        self._last_beat_wall = time.monotonic()
         _HEARTBEAT.set(float(self._events_done))
 
     # -- convergence -------------------------------------------------------

@@ -81,6 +81,27 @@ MATRIX = [
     # invariant gates before the sustained mixed tx/s is recorded
     ("soak", ["--metric", "soak", "--soak-seed", "8",
               "--soak-events", "12"], {}, 1200),
+    # FMT_TRACE-armed commitpipe on the DEVICE verifier: the traced
+    # arm's verdict/fingerprint identity + stage-attribution sum gate
+    # run against real hardware, the span ring lands as a Perfetto-
+    # loadable chrome trace, FMT_TRACE_JAX_PROFILE captures a one-shot
+    # jax.profiler device profile around a batch dispatch, and
+    # fabric_tpu_compiles_total counts XLA compiles/retraces — the
+    # first on-chip answer to WHICH sub-stage the next kernel should
+    # vectorize
+    ("commitpipe_traced",
+     ["--metric", "commitpipe", "--trace-out",
+      os.path.join(OUTDIR, "commitpipe_trace.json")],
+     {"FMT_TRACE": "1",
+      "FMT_TRACE_JAX_PROFILE": os.path.join(OUTDIR, "jaxprof")}, 1500),
+    # FMT_TRACE-armed e2e: the stage-attribution breakdown
+    # (recv/unpack/der_marshal/device_dispatch/verdict_await/
+    # policy_eval/mvcc/ledger_write) recorded on hardware, so the
+    # vectorized-policy/MVCC roadmap item points at a measured number
+    ("e2e_traced",
+     ["--metric", "e2e", "--trace-out",
+      os.path.join(OUTDIR, "e2e_trace.json")],
+     {"FMT_TRACE": "1"}, 1500),
 ]
 
 

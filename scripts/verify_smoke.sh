@@ -12,7 +12,10 @@
 #   3. the commit-pipeline differential: pipelined-vs-sync committed
 #      blocks with mixed barrier/non-barrier streams, asserting
 #      per-block txflags + final state-hash identity (sw verifier so
-#      no XLA compile — the identity assertion runs on every change)
+#      no XLA compile — the identity assertion runs on every change);
+#      since PR 9 the metric also runs a FMT_TRACE-armed arm whose
+#      verdicts/fingerprints must match AND whose sub-span totals
+#      must explain the stage/await/commit buckets within 10%
 # all on the CPU backend with a small batch — a wheel-less container
 # can run this in a few minutes, no TPU needed.
 #
@@ -65,6 +68,17 @@ FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
 FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
     -p no:cacheprovider -p no:randomly -m 'not slow' \
     tests/test_soak.py
+# 0e. the trace slice, ARMED (FMT_TRACE=1) on top of the race lane:
+#     the span/timeline layer runs live over the commitpipe
+#     differential — verdicts and state fingerprints must stay
+#     identical with tracing on (tests/test_tracing.py pins the
+#     armed-vs-unarmed differential, the cross-thread context
+#     propagation, the flight-recorder ring bounds, and the Chrome
+#     trace-event export schema), and test_commitpipe re-runs its
+#     whole differential with every span seam armed
+FMT_TRACE=1 FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
+    -p no:cacheprovider -p no:randomly -m 'not slow' \
+    tests/test_tracing.py tests/test_commitpipe.py
 # CPU XLA compiles of the verify cores run multiple minutes each (the
 # persistent compile cache is TPU-oriented); give the worker room.
 export FABRIC_MOD_TPU_BENCH_TIMEOUT="${FABRIC_MOD_TPU_BENCH_TIMEOUT:-2400}"

@@ -92,6 +92,134 @@ def test_ops_server_endpoints():
         activate_spec("info")
 
 
+def test_debug_profile_alias_and_threads():
+    """/debug/profile?seconds=N is the documented alias of the
+    sampling profiler and /debug/threads serves without SIGUSR1 —
+    a wedged soak run is diagnosable over HTTP alone."""
+    import urllib.request
+    srv = OperationsServer(provider=MetricsProvider(),
+                           health=HealthRegistry())
+    srv.start()
+    host, port = srv.addr
+    base = f"http://{host}:{port}"
+    try:
+        with urllib.request.urlopen(
+                base + "/debug/profile?seconds=0.2", timeout=10) as r:
+            assert "collapsed stacks" in r.read().decode()
+        with urllib.request.urlopen(base + "/debug/threads",
+                                    timeout=10) as r:
+            assert "thread" in r.read().decode()
+        # a bad seconds parameter answers 400, not a hung profiler
+        try:
+            urllib.request.urlopen(base + "/debug/profile?seconds=x")
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        srv.stop()
+
+
+def test_default_health_carries_breaker_and_commitpipe_checkers():
+    """Satellite contract: the registry exists AND things register
+    into it — an open device circuit and a poisoned commit pipeline
+    both flip the process-default /healthz."""
+    from fabric_mod_tpu.bccsp.breaker import CircuitBreaker
+    from fabric_mod_tpu.observability.opsserver import default_health
+
+    def mine(failures):
+        # keys are per-INSTANCE (name#seq): a second breaker sharing
+        # the name must never mask this one's open circuit
+        return [v for k, v in failures.items()
+                if k.startswith("breaker[healthtest#")]
+
+    reg = default_health()
+    br = CircuitBreaker(k=1, interval_s=0, name="healthtest")
+    try:
+        status, failures = reg.status()
+        assert not mine(failures)
+        br.record_failure()                # k=1: opens
+        status, failures = reg.status()
+        assert status != "OK"
+        assert any("OPEN" in v for v in mine(failures))
+        # a SECOND same-named breaker must not mask the open one
+        br2 = CircuitBreaker(k=1, interval_s=0, name="healthtest")
+        _, failures = reg.status()
+        assert any("OPEN" in v for v in mine(failures))
+        br2.stop()
+        assert br.probe_now()              # no probe fn => heals
+        _, failures = reg.status()
+        assert not mine(failures)
+    finally:
+        br.stop()                          # stop() unregisters
+    _, failures = reg.status()
+    assert not mine(failures)
+
+    # the ops server built with NO registry serves the default one
+    import urllib.request
+    reg.register("forced-down", lambda: (_ for _ in ()).throw(
+        RuntimeError("down")))
+    srv = OperationsServer(provider=MetricsProvider())
+    srv.start()
+    host, port = srv.addr
+    try:
+        urllib.request.urlopen(f"http://{host}:{port}/healthz")
+        assert False, "expected 503"
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        assert json.load(e)["failed_checks"]["forced-down"] == "down"
+    finally:
+        srv.stop()
+        reg.unregister("forced-down")
+
+
+def test_commitpipe_poison_flips_default_health(tmp_path):
+    from fabric_mod_tpu.observability.opsserver import default_health
+    from fabric_mod_tpu.peer.commitpipe import PipelinedCommitter
+
+    class _Boom:
+        class ledger:
+            height = 0
+
+        def stage_block(self, block):
+            raise RuntimeError("staged boom")
+
+        def commit_staged(self, staged):
+            raise AssertionError("unreached")
+
+    class _Block:
+        class header:
+            number = 0
+
+    import time as _t
+
+    def mine(failures):
+        return [v for k, v in failures.items()
+                if k.startswith("commitpipe[healthtest#")]
+
+    reg = default_health()
+    pipe = PipelinedCommitter(_Boom(), depth=1, consumer="healthtest")
+    try:
+        pipe.submit(_Block())
+        deadline = _t.monotonic() + 10
+        while pipe.error is None and _t.monotonic() < deadline:
+            _t.sleep(0.01)
+        assert pipe.error is not None
+        _, failures = reg.status()
+        assert any("poisoned" in v for v in mine(failures))
+        # per-instance keys: a healthy sibling engine with the same
+        # consumer label must not mask the poisoned one
+        healthy = PipelinedCommitter(_Boom(), depth=1,
+                                     consumer="healthtest")
+        _, failures = reg.status()
+        assert any("poisoned" in v for v in mine(failures))
+        healthy.close()
+        pipe.close()           # discarded pipe leaves the registry
+        _, failures = reg.status()
+        assert not mine(failures)
+    finally:
+        pipe.close()
+
+
 def test_pprof_sampling_profile(tmp_path):
     """/debug/pprof returns collapsed stacks with sample counts
     attributing a busy thread (the pprof-analog, SURVEY §5.1)."""

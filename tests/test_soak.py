@@ -106,8 +106,15 @@ def test_divergence_fails_loudly_with_seed_and_schedule():
     plan = ChurnPlan(42, 5)
     checker = InvariantChecker(_StubLedgerWorld(), _StubWorkload(),
                                plan, recovery_window_s=3.0)
-    with pytest.raises(SoakError) as ei:
-        checker.check_converged("leader_kill")
+    try:
+        with pytest.raises(SoakError) as ei:
+            checker.check_converged("leader_kill")
+    finally:
+        # drop the heartbeat checker this constructor registered into
+        # the process-default health registry (a harness run does this
+        # in its own teardown) — a leaked one would flip /healthz for
+        # every later test once it turned stale
+        checker.close_health()
     msg = str(ei.value)
     assert "DIVERGED" in msg
     assert "--soak-seed 42" in msg            # the replay command
