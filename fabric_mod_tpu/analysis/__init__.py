@@ -1,0 +1,14 @@
+"""fmtlint: project-native static analysis over the package tree.
+
+``python -m fabric_mod_tpu.analysis`` lints the whole package (also
+run as a tier-1 test); ``--list-rules`` documents every rule and the
+pragma syntax.  See engine.py for the pragma grammar and rules.py for
+the catalog.
+"""
+from fabric_mod_tpu.analysis.engine import (Finding, ModuleInfo,
+                                            RunResult, load_module,
+                                            run)
+from fabric_mod_tpu.analysis.rules import ALL_RULES, LISTED_RULES
+
+__all__ = ["Finding", "ModuleInfo", "RunResult", "load_module", "run",
+           "ALL_RULES", "LISTED_RULES"]

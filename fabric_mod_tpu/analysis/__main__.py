@@ -1,0 +1,59 @@
+"""CLI: ``python -m fabric_mod_tpu.analysis``.
+
+Exit 0 = clean tree, 1 = findings, 2 = usage error.  The whole-package
+run (no paths) additionally runs the project checks (unused registry
+entries) and the README knob-table drift check — exactly what the
+tier-1 gate in tests/test_analysis.py asserts.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from fabric_mod_tpu.analysis.engine import run
+from fabric_mod_tpu.analysis.rules import LISTED_RULES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m fabric_mod_tpu.analysis",
+        description="fmtlint: project-native static analysis — the "
+                    "repo's runtime disciplines as compile-time gates")
+    ap.add_argument("paths", nargs="*",
+                    help="files to lint (default: the whole package, "
+                         "plus registry + README cross-checks)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print every rule + the pragma syntax and exit")
+    ap.add_argument("--knob-table", action="store_true",
+                    help="print the README knob table generated from "
+                         "utils/knobs.py and exit")
+    ap.add_argument("--no-docs-check", action="store_true",
+                    help="skip the README drift check on whole-package "
+                         "runs")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print("fmtlint rules (suppress per line with a comment "
+              "'fmtlint: allow[<rule>] -- <reason>'):\n")
+        for rule in LISTED_RULES:
+            print(f"  {rule.name}")
+            for line in rule.doc.splitlines():
+                print(f"      {line}")
+        return 0
+    if args.knob_table:
+        from fabric_mod_tpu.analysis.docs import render_readme_section
+        print(render_readme_section())
+        return 0
+
+    result = run(paths=args.paths or None,
+                 docs_check=not args.no_docs_check)
+    for f in result.findings:
+        print(f.render())
+    print(f"fmtlint: {len(result.findings)} finding(s), "
+          f"{result.suppressed} suppressed by pragma, "
+          f"{result.files} file(s)", file=sys.stderr)
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

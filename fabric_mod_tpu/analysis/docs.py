@@ -1,0 +1,77 @@
+"""Docs cross-checks + the generated knob table.
+
+The README "Knob registry" table is GENERATED from utils/knobs.py
+(``python -m fabric_mod_tpu.analysis --knob-table``) between the
+``<!-- fmtlint:knob-table -->`` markers; :func:`check_readme` fails
+the lint run when either direction drifts — a declared knob missing
+from the README, or a knob-shaped name in the README that no registry
+entry backs.
+"""
+from __future__ import annotations
+
+import re
+from typing import List
+
+from fabric_mod_tpu.analysis.engine import REPO_DIR, Finding
+from fabric_mod_tpu.utils import knobs
+
+TABLE_BEGIN = "<!-- fmtlint:knob-table -->"
+TABLE_END = "<!-- /fmtlint:knob-table -->"
+
+# tokens in prose/tables; trailing [A-Z0-9] so "FMT_SOAK_*" yields the
+# checkable prefix "FMT_SOAK" rather than "FMT_SOAK_"
+_TOKEN_RE = re.compile(r"(?:FABRIC_MOD_TPU|FMT)(?:_[A-Z0-9]+)*")
+
+
+def knob_table_markdown() -> str:
+    rows = ["| knob | type | default | doc |",
+            "|---|---|---|---|"]
+    for k in knobs.knob_table():
+        default = "unset" if k.default is None else f"`{k.default}`"
+        rows.append(f"| `{k.name}` | {k.type} | {default} | {k.doc} |")
+    return "\n".join(rows)
+
+
+def render_readme_section() -> str:
+    return f"{TABLE_BEGIN}\n{knob_table_markdown()}\n{TABLE_END}"
+
+
+def check_readme(readme_text: str = None) -> List[Finding]:
+    path = REPO_DIR / "README.md"
+    if readme_text is None:
+        if not path.exists():
+            return []
+        readme_text = path.read_text()
+    declared = set(knobs.declared())
+    findings: List[Finding] = []
+    tokens = set(_TOKEN_RE.findall(readme_text))
+    for tok in sorted(tokens):
+        if tok in declared:
+            continue
+        # a prefix form like FMT_SOAK (from "FMT_SOAK_*") is fine when
+        # declared knobs live under it
+        if any(d.startswith(tok + "_") for d in declared):
+            continue
+        findings.append(Finding(
+            "README.md", 1, "knobs",
+            f"README names knob-shaped {tok!r} that no "
+            f"utils/knobs.py entry declares"))
+    for name in sorted(declared - tokens):
+        findings.append(Finding(
+            "README.md", 1, "knobs",
+            f"declared knob {name!r} is missing from the README "
+            f"(regenerate: python -m fabric_mod_tpu.analysis "
+            f"--knob-table)"))
+    if TABLE_BEGIN in readme_text:
+        inner = readme_text.split(TABLE_BEGIN, 1)[1]
+        if TABLE_END not in inner:
+            findings.append(Finding(
+                "README.md", 1, "knobs",
+                f"unterminated {TABLE_BEGIN} section"))
+        elif inner.split(TABLE_END, 1)[0].strip() != \
+                knob_table_markdown().strip():
+            findings.append(Finding(
+                "README.md", 1, "knobs",
+                "generated knob table is stale — regenerate with "
+                "python -m fabric_mod_tpu.analysis --knob-table"))
+    return findings

@@ -1,0 +1,206 @@
+"""fmtlint: the AST rule engine.
+
+(reference: the role ``go vet`` + custom analyzers play in the Go
+stack — project-specific invariants enforced at compile time.  Our
+runtime disciplines (FMT_RACECHECK guards, fault seams, spans, the
+knob registry, injectable clocks) each have a *dynamic* half already;
+this engine is the *static* half: the discipline is checked on every
+change, over the whole tree, without a reviewer re-deriving it.)
+
+A run parses every production module once, hands the tree to each
+registered rule, collects :class:`Finding` objects, and filters them
+through per-line pragmas::
+
+    some_violating_line()   # fmtlint: allow[locks] -- why it's OK here
+
+The pragma REQUIRES a reason (`` -- text``); a reasonless or
+unknown-rule pragma is itself a finding (rule ``pragma``), so
+suppressions stay reviewable.  A pragma may sit on the violating line
+or on the line directly above it (for lines that would overflow).
+
+Rules are checked per module; rules that need whole-tree knowledge
+(declared-but-unused fault points / span names, the README knob-table
+drift check) run as *project checks* after the per-module pass, when
+the run covers the whole package.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+PKG_DIR = Path(__file__).resolve().parent.parent        # fabric_mod_tpu/
+REPO_DIR = PKG_DIR.parent
+
+# one pragma grammar (as a comment): fmtlint: allow[rules...] -- reason
+PRAGMA_RE = re.compile(
+    r"#\s*fmtlint:\s*allow\[([^\]]*)\]\s*(?:--\s*(\S.*))?")
+_PRAGMA_MARK = re.compile(r"#\s*fmtlint\b")
+
+KNOB_RE = re.compile(r"^(?:FABRIC_MOD_TPU|FMT)_[A-Z0-9_]+$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str                  # repo-relative
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    """One parsed production module plus its pragma map."""
+    path: Path
+    relpath: str               # repo-relative, posix separators
+    pkgpath: str               # relative to fabric_mod_tpu/, posix
+    tree: ast.AST
+    lines: List[str]
+    # line -> set of rule names allowed there ("*" = all)
+    pragmas: Dict[int, Set[str]]
+    pragma_findings: List[Finding]
+
+
+def _parse_pragmas(relpath: str, lines: Sequence[str],
+                   known_rules: Set[str]
+                   ) -> Tuple[Dict[int, Set[str]], List[Finding]]:
+    pragmas: Dict[int, Set[str]] = {}
+    findings: List[Finding] = []
+    for lineno, text in enumerate(lines, start=1):
+        if not _PRAGMA_MARK.search(text):
+            continue
+        m = PRAGMA_RE.search(text)
+        if m is None:
+            findings.append(Finding(
+                relpath, lineno, "pragma",
+                "malformed fmtlint pragma: expected a comment "
+                "'fmtlint: allow[<rule>] -- <reason>'"))
+            continue
+        rules_raw, reason = m.group(1), m.group(2)
+        names = {r.strip() for r in rules_raw.split(",") if r.strip()}
+        if not names:
+            findings.append(Finding(
+                relpath, lineno, "pragma",
+                "fmtlint pragma allows no rules"))
+            continue
+        unknown = sorted(n for n in names if n not in known_rules)
+        if unknown:
+            findings.append(Finding(
+                relpath, lineno, "pragma",
+                f"fmtlint pragma names unknown rule(s) {unknown} "
+                f"(see --list-rules)"))
+        if not reason:
+            findings.append(Finding(
+                relpath, lineno, "pragma",
+                "fmtlint pragma without a reason: append "
+                "'-- <why this is sanctioned here>'"))
+            continue
+        # a pragma covers its own line and, when it stands alone on a
+        # comment line, the line below it
+        pragmas.setdefault(lineno, set()).update(names)
+        if text.lstrip().startswith("#"):
+            pragmas.setdefault(lineno + 1, set()).update(names)
+    return pragmas, findings
+
+
+def load_module(path: Path, known_rules: Set[str]) -> ModuleInfo:
+    src = path.read_text()
+    try:
+        rel = path.resolve().relative_to(REPO_DIR).as_posix()
+    except ValueError:
+        rel = str(path)
+    try:
+        pkg = path.resolve().relative_to(PKG_DIR).as_posix()
+    except ValueError:
+        pkg = rel
+    lines = src.splitlines()
+    pragmas, pragma_findings = _parse_pragmas(rel, lines, known_rules)
+    return ModuleInfo(path=path, relpath=rel, pkgpath=pkg,
+                      tree=ast.parse(src, filename=str(path)),
+                      lines=lines, pragmas=pragmas,
+                      pragma_findings=pragma_findings)
+
+
+class ProjectContext:
+    """Cross-module accumulator the rules feed during the per-module
+    pass; the project checks read it afterwards."""
+
+    def __init__(self, full_package: bool):
+        self.full_package = full_package
+        self.fault_points_used: Set[str] = set()
+        self.span_names_used: Set[str] = set()
+
+
+def discover(root: Path) -> List[Path]:
+    """Production modules under `root` (tests and bench live outside
+    the package and are intentionally out of scope — synthetic knob
+    names, fault points, and raw threads are legitimate there)."""
+    return sorted(p for p in root.rglob("*.py") if "__pycache__" not in p.parts)
+
+
+@dataclasses.dataclass
+class RunResult:
+    findings: List[Finding]
+    suppressed: int
+    files: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def check_module(mod: ModuleInfo, active: Sequence,
+                 ctx: ProjectContext
+                 ) -> Tuple[List[Finding], int]:
+    """Run `active` rules over one parsed module and filter through
+    its pragmas.  Returns (findings, suppressed-count).  This is the
+    exact per-module path :func:`run` takes — the fixture tests in
+    tests/test_analysis.py call it directly so suppressed fixtures
+    exercise the same pragma filter as the tree gate."""
+    raw: List[Finding] = list(mod.pragma_findings)
+    for rule in active:
+        raw.extend(rule.check(mod, ctx))
+    findings: List[Finding] = []
+    suppressed = 0
+    for f in raw:
+        allowed = mod.pragmas.get(f.line, ())
+        if f.rule != "pragma" and (f.rule in allowed or "*" in allowed):
+            suppressed += 1
+        else:
+            findings.append(f)
+    return findings, suppressed
+
+
+def run(paths: Optional[Sequence[Path]] = None,
+        rules: Optional[Sequence] = None,
+        docs_check: bool = True) -> RunResult:
+    """Lint `paths` (default: the whole package).  Project checks and
+    the README drift check only run on whole-package runs — partial
+    runs cannot judge declared-but-unused registries."""
+    from fabric_mod_tpu.analysis.rules import ALL_RULES, project_checks
+    active = list(rules) if rules is not None else list(ALL_RULES)
+    known = {r.name for r in ALL_RULES} | {"pragma"}
+    full = paths is None
+    files = discover(PKG_DIR) if full else [Path(p) for p in paths]
+
+    ctx = ProjectContext(full_package=full)
+    findings: List[Finding] = []
+    suppressed = 0
+    for path in files:
+        mod = load_module(path, known)
+        mod_findings, mod_suppressed = check_module(mod, active, ctx)
+        findings.extend(mod_findings)
+        suppressed += mod_suppressed
+    if full:
+        findings.extend(project_checks(ctx))
+        if docs_check:
+            from fabric_mod_tpu.analysis.docs import check_readme
+            findings.extend(check_readme())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return RunResult(findings=findings, suppressed=suppressed,
+                     files=len(files))

@@ -37,7 +37,7 @@ from fabric_mod_tpu.observability import tracing
 from fabric_mod_tpu.observability.metrics import (MetricOpts,
                                                   default_provider)
 from fabric_mod_tpu.observability.opsserver import default_health
-from fabric_mod_tpu.utils.env import env_float, env_int
+from fabric_mod_tpu.utils import knobs
 
 _STATE_OPTS = MetricOpts(
     "fabric", "bccsp", "breaker_state",
@@ -67,18 +67,17 @@ def _metrics():
 _breaker_seq = itertools.count()
 
 
-def breaker_k(default: int = 3) -> int:
+def breaker_k() -> int:
     """FABRIC_MOD_TPU_BREAKER_K: consecutive device failures that open
     the circuit; 0 disables the breaker (device errors keep failing
     over per-batch, but the device is always retried)."""
-    return max(0, env_int("FABRIC_MOD_TPU_BREAKER_K", default))
+    return max(0, knobs.get_int("FABRIC_MOD_TPU_BREAKER_K"))
 
 
-def probe_interval_s(default: float = 5.0) -> float:
+def probe_interval_s() -> float:
     """FABRIC_MOD_TPU_BREAKER_PROBE_S: background probe period while
     open; 0 disables the prober thread (probe_now() only)."""
-    return max(0.0, env_float("FABRIC_MOD_TPU_BREAKER_PROBE_S",
-                              default))
+    return max(0.0, knobs.get_float("FABRIC_MOD_TPU_BREAKER_PROBE_S"))
 
 
 class CircuitBreaker:
@@ -218,8 +217,8 @@ class CircuitBreaker:
                 return
             try:
                 self.probe_now()
-            except Exception:
-                pass                       # a raising probe is a failure
+            except Exception:  # fmtlint: allow[swallowed-exceptions] -- a raising probe IS the failure signal: the circuit stays open and opens_total already counts it
+                pass
             with self._lock:
                 # exit ONLY while verifiably closed, deregistering in
                 # the same critical section: record_failure's

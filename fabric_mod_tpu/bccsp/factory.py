@@ -13,9 +13,10 @@ from typing import Optional
 
 from fabric_mod_tpu.bccsp.api import BCCSP
 from fabric_mod_tpu.bccsp.sw import SwCSP
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 _default: Optional[BCCSP] = None
-_lock = threading.Lock()
+_lock = RegisteredLock("bccsp.factory._lock")
 
 
 def new_provider(config: Optional[dict] = None) -> BCCSP:

@@ -46,6 +46,7 @@ from fabric_mod_tpu.orderer import Broadcast, DeliverService, Registrar
 from fabric_mod_tpu.peer.channel import Channel
 from fabric_mod_tpu.peer.deliverclient import DeliverClient
 from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.concurrency.threads import RegisteredThread
 
 log = get_logger("node")
 
@@ -171,8 +172,9 @@ def run_node(genesis_path: str, crypto_dir: str, orderer_org: str,
 
     client = DeliverClient(channel, DeliverService(support),
                            queue_size=peer_cfg.deliver_queue_size)
-    runner = threading.Thread(
-        target=lambda: client.run(idle_timeout_s=3600.0), daemon=True)
+    runner = RegisteredThread(
+        target=lambda: client.run(idle_timeout_s=3600.0),
+        name="node-deliver", structure="cli.node")
     runner.start()
 
     stop = stop_event or threading.Event()
@@ -344,8 +346,9 @@ def run_peer(org: str, genesis_path: str, crypto_dir: str,
     source = FailoverDeliverSource(endpoints, cid)
     client = DeliverClient(channel, source,
                            queue_size=peer_cfg.deliver_queue_size)
-    runner = threading.Thread(
-        target=lambda: client.run(idle_timeout_s=3600.0), daemon=True)
+    runner = RegisteredThread(
+        target=lambda: client.run(idle_timeout_s=3600.0),
+        name="peer-deliver", structure="cli.node")
     runner.start()
 
     # the endorsement surface (reference: core/endorser's

@@ -19,6 +19,7 @@ from concurrent import futures
 from typing import Callable, Dict, Optional, Tuple
 
 import grpc
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 _IDENT = (lambda b: b, lambda b: b)
 
@@ -40,7 +41,7 @@ class MethodKind:
 # -- common/grpclogging/server.go — every server handler is wrapped
 # -- with request counters, a duration histogram, and debug logs) -----------
 
-_rpc_metrics_lock = threading.Lock()
+_rpc_metrics_lock = RegisteredLock("comm.grpc_comm._rpc_metrics_lock")
 _rpc_metrics = None
 
 

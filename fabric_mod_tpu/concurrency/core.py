@@ -11,8 +11,9 @@ is still a cycle.
 from __future__ import annotations
 
 import contextlib
-import os
 import threading
+
+from fabric_mod_tpu.utils import knobs
 
 
 class RaceError(AssertionError):
@@ -20,7 +21,7 @@ class RaceError(AssertionError):
     frameworks treat it as a hard failure, never a skip)."""
 
 
-_enabled = os.environ.get("FMT_RACECHECK", "") not in ("", "0")
+_enabled = knobs.get_bool("FMT_RACECHECK")
 
 
 def enabled() -> bool:

@@ -20,6 +20,7 @@ from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 from fabric_mod_tpu.channelconfig.bundle import Bundle
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos.protoutil import SignedData
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 MAX_LAYOUTS = 64                     # combinatorics cap (like reference)
 
@@ -134,7 +135,7 @@ class DiscoveryService:
         self._membership = membership_fn
         self._verify_many = verify_many
         self._auth_cache: Dict[bytes, bool] = {}
-        self._auth_lock = threading.Lock()
+        self._auth_lock = RegisteredLock("discovery.service._auth_lock")
 
     # -- auth (reference: authcache.go:196) ------------------------------
     def check_access(self, sd: SignedData) -> bool:

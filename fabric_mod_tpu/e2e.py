@@ -28,6 +28,7 @@ from fabric_mod_tpu.peer.channel import Channel
 from fabric_mod_tpu.peer.deliverclient import DeliverClient
 from fabric_mod_tpu.peer.endorser import Endorser, endorse_and_submit
 from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.concurrency.threads import RegisteredThread
 
 
 class Network:
@@ -132,10 +133,10 @@ class Network:
     def pump_committed(self, want_txs: int, timeout: float = 30.0
                        ) -> int:
         """Run a deliver client until `want_txs` total txs committed."""
-        import threading as _th
         client = self.deliver_client()
-        t = _th.Thread(target=lambda: client.run(idle_timeout_s=5.0),
-                       daemon=True)
+        t = RegisteredThread(
+            target=lambda: client.run(idle_timeout_s=5.0),
+            name="e2e-deliver", structure="e2e")
         t.start()
         deadline = time.time() + timeout
         committed = 0
@@ -239,8 +240,9 @@ def run_pipeline(n_txs: int, verifier, reps_unused: int = 1,
                 net.broadcast.submit(env)
             # orderer cuts blocks; peer pulls + commits
             client = net.deliver_client()
-            import threading
-            runner = threading.Thread(target=client.run, daemon=True)
+            runner = RegisteredThread(target=client.run,
+                                      name="e2e-deliver-runner",
+                                      structure="e2e")
             runner.start()
             # wait until everything committed; the floor covers a COLD
             # XLA compile of the verify program inside the first
@@ -258,6 +260,7 @@ def run_pipeline(n_txs: int, verifier, reps_unused: int = 1,
                 time.sleep(0.01)
             dt = time.perf_counter() - t0
             client.stop()
+            runner.join(timeout=30)
             if committed < n_txs:
                 raise RuntimeError(
                     f"only {committed}/{n_txs} txs committed")

@@ -7,10 +7,13 @@ trigger catalog (fire-on-Nth-call / seeded-probability / one-shot).
 """
 from fabric_mod_tpu.faults.core import (FaultPlan, FaultRule,
                                         InjectedFault, active, arm,
-                                        armed, current_plan, disarm,
-                                        point)
+                                        arm_spec, armed, current_plan,
+                                        disarm, point)
+from fabric_mod_tpu.faults.points import (DECLARED_POINTS,
+                                          declared_point)
 
 __all__ = [
     "InjectedFault", "FaultRule", "FaultPlan",
-    "point", "arm", "disarm", "active", "armed", "current_plan",
+    "point", "arm", "arm_spec", "disarm", "active", "armed",
+    "current_plan", "DECLARED_POINTS", "declared_point",
 ]

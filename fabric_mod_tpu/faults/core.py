@@ -49,13 +49,15 @@ from __future__ import annotations
 
 import contextlib
 import functools
-import os
 import random
 import threading
 from typing import Dict, List, Optional
 
+from fabric_mod_tpu.faults import points as _points
 from fabric_mod_tpu.observability.metrics import (MetricOpts,
                                                   default_provider)
+from fabric_mod_tpu.utils import knobs as _knobs
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 _FIRED_OPTS = MetricOpts(
     "fabric", "faults", "injected_total",
@@ -143,7 +145,7 @@ class FaultPlan:
 
     def __init__(self):
         self._rules: Dict[str, List[FaultRule]] = {}
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("faults.core._lock")
 
     def add(self, point: str, mode: str = "error", kind: str = "fault",
             nth: Optional[int] = None, p: Optional[float] = None,
@@ -183,6 +185,22 @@ class FaultPlan:
     def calls(self, point: str) -> int:
         with self._lock:
             return sum(r.calls for r in self._rules.get(point, []))
+
+    def validate(self) -> "FaultPlan":
+        """Check every rule's point against the fault-point registry
+        (faults/points.py); an unknown name raises immediately instead
+        of arming a rule that silently never fires.  Returns self so
+        the env-arming path chains it."""
+        with self._lock:
+            unknown = sorted(p for p in self._rules
+                             if not _points.is_declared(p))
+        if unknown:
+            raise ValueError(
+                f"fault plan names unknown injection point(s) "
+                f"{unknown}: declared points live in "
+                f"fabric_mod_tpu/faults/points.py "
+                f"(known: {sorted(_points.DECLARED_POINTS)})")
+        return self
 
     @classmethod
     def from_spec(cls, spec: str) -> "FaultPlan":
@@ -282,6 +300,15 @@ def point(name: str) -> bool:
     return True
 
 
-_env_spec = os.environ.get("FMT_FAULTS", "")
+def arm_spec(spec: str) -> FaultPlan:
+    """Parse + VALIDATE + arm an FMT_FAULTS-grammar plan: the
+    production chaos path.  A typo'd point name raises here, at arm
+    time, instead of running a chaos plan that injects nothing."""
+    plan = FaultPlan.from_spec(spec).validate()
+    arm(plan)
+    return plan
+
+
+_env_spec = _knobs.get_str("FMT_FAULTS")
 if _env_spec:
-    arm(FaultPlan.from_spec(_env_spec))
+    arm_spec(_env_spec)

@@ -19,9 +19,12 @@ from typing import Callable, Dict, List, Optional
 from fabric_mod_tpu import faults
 from fabric_mod_tpu.concurrency import (GuardedQueue, RegisteredLock,
                                         RegisteredThread, assert_joined)
+from fabric_mod_tpu.observability.logging import get_logger
 from fabric_mod_tpu.protos import messages as m
-from fabric_mod_tpu.utils.env import env_int
+from fabric_mod_tpu.utils import knobs
 from fabric_mod_tpu.utils.retry import Retrier
+
+log = get_logger("gossip.comm")
 
 Handler = Callable[[bytes, bytes], None]     # (src_pki_id, envelope bytes)
 
@@ -68,7 +71,7 @@ class InProcNetwork:
     """Endpoint registry + direct delivery (the wire stand-in)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("gossip.comm._lock")
         self._handlers: Dict[str, Handler] = {}
         self.partitioned: set = set()        # endpoints cut off (tests)
 
@@ -160,8 +163,8 @@ class GRPCGossipNetwork:
         # FABRIC_MOD_TPU_GOSSIP_SEND_RETRIES, default 2; 0 restores
         # the old drop-on-first-failure behavior.
         if send_retries is None:
-            send_retries = env_int(
-                "FABRIC_MOD_TPU_GOSSIP_SEND_RETRIES", 2)
+            send_retries = knobs.get_int(
+                "FABRIC_MOD_TPU_GOSSIP_SEND_RETRIES")
         self._send_retries = max(0, send_retries)
         self._retrier = retrier if retrier is not None else Retrier(
             base_s=0.05, max_s=min(1.0, send_timeout_s),
@@ -210,8 +213,8 @@ class GRPCGossipNetwork:
         for q in queues:
             try:
                 q.put_nowait(None)
-            except Exception:
-                pass                       # senders poll _stopped too
+            except Exception:  # fmtlint: allow[swallowed-exceptions] -- wake sentinel on a full queue: senders poll _stopped too
+                pass
         for c in clients:
             c.close()
         self.server.stop()
@@ -300,8 +303,10 @@ class GRPCGossipNetwork:
                 # between attempts so each retry redials
                 self._retrier.call(self._attempt_send, endpoint,
                                    payload)
-            except Exception:
-                pass          # budget exhausted: drop (gossip re-sends)
+            except Exception as e:
+                # budget exhausted: drop (gossip re-sends)
+                log.debug("gossip send to %s dropped after "
+                          "retries: %r", endpoint, e)
 
     def _attempt_send(self, endpoint: str, payload: bytes) -> bytes:
         """One send attempt, NACK re-handshake included; on failure
@@ -383,8 +388,9 @@ class GRPCGossipNetwork:
                         cache.clear()
                     h = cache[pem] = _pem_cert_der_hash(pem)
                 return h
-        except Exception:
-            pass
+        except Exception as e:
+            log.debug("peer cert hash failed (auth downgraded "
+                      "to empty): %r", e)
         return b""
 
     def _on_connect(self, request: bytes, context) -> bytes:
@@ -496,8 +502,8 @@ class GRPCGossipNetwork:
                 handler = self._handlers.get(d["dst"])
             if handler is not None:
                 handler(claimed_pki, self._unb64(d["env"]))
-        except Exception:
-            pass
+        except Exception as e:
+            log.debug("inbound gossip dispatch failed: %r", e)
         return b""
 
 

@@ -16,6 +16,8 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.concurrency.threads import RegisteredThread
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 
 class MemberInfo:
@@ -39,16 +41,20 @@ def _fresher(a: m.PeerTime, b: m.PeerTime) -> bool:
 class Discovery:
     def __init__(self, self_member: m.GossipMember, identity: bytes,
                  comm, expiry_s: float = 5.0,
-                 on_expire: Optional[Callable[[bytes], None]] = None):
+                 on_expire: Optional[Callable[[bytes], None]] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self._self = self_member
         self._self_pki = self_member.pki_id
         self._identity = identity
         self._comm = comm
         self.expiry_s = expiry_s
         self._on_expire = on_expire
-        self._inc = int(time.time() * 1000)
+        # injectable liveness clock (tests drive expiry via `now=` or
+        # a fake clock; the default is wall time)
+        self._clock = clock if clock is not None else time.time
+        self._inc = int(self._clock() * 1000)
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("gossip.discovery._lock")
         self._members: Dict[bytes, MemberInfo] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -78,7 +84,7 @@ class Discovery:
         """Expire members not heard from within expiry_s
         (reference: periodicalCheckAlive :697 + expireDeadMembers
         :710).  Returns expired PKI-IDs."""
-        now = now if now is not None else time.time()
+        now = now if now is not None else self._clock()
         expired = []
         with self._lock:
             for pid, info in list(self._members.items()):
@@ -100,7 +106,7 @@ class Discovery:
             return False
         if pki_id == self._self_pki:
             return False               # our own forwarded heartbeat
-        now = now if now is not None else time.time()
+        now = now if now is not None else self._clock()
         with self._lock:
             cur = self._members.get(pki_id)
             if cur is not None and not _fresher(alive.timestamp,
@@ -116,7 +122,9 @@ class Discovery:
             while not self._stop.wait(interval_s):
                 self.tick_send_alive()
                 self.tick_check_alive()
-        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread = RegisteredThread(target=loop,
+                                        name="discovery-loop",
+                                        structure="gossip.discovery")
         self._thread.start()
 
     def stop(self) -> None:

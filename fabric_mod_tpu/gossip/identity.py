@@ -14,6 +14,7 @@ import threading
 from typing import Callable, Dict, Optional
 
 from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 
 def pki_id_of(serialized_identity: bytes) -> bytes:
@@ -24,7 +25,7 @@ class IdentityMapper:
     def __init__(self, msp_mgr, verifier=None):
         self._msp = msp_mgr
         self._verifier = verifier
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("gossip.identity._lock")
         self._store: Dict[bytes, bytes] = {}    # pki_id -> serialized
 
     def put(self, serialized_identity: bytes) -> bytes:

@@ -16,6 +16,7 @@ import threading
 import time
 from collections import deque
 from typing import Deque, Optional, Tuple
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 
 class TTLMessageStore:
@@ -32,7 +33,7 @@ class TTLMessageStore:
         self._width = ttl_s / n_buckets
         self._n = n_buckets
         self._max = max_entries
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("gossip.msgstore._lock")
         self._count = 0
         self._buckets: Deque[Tuple[int, set]] = deque()
 

@@ -31,6 +31,8 @@ from typing import Callable, Optional
 from fabric_mod_tpu.gossip.election import LeaderElectionService
 from fabric_mod_tpu.observability import get_logger
 from fabric_mod_tpu.peer.deliverclient import DeliverClient
+from fabric_mod_tpu.concurrency.threads import RegisteredThread
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 log = get_logger("gossip.service")
 
@@ -52,7 +54,7 @@ class GossipService:
         self._client: Optional[DeliverClient] = None
         self._client_thread: Optional[threading.Thread] = None
         self._client_halt: Optional[threading.Event] = None
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("gossip.service._lock")
         self.election = LeaderElectionService(
             node.pki_id,
             lambda: [mb.pki_id for mb in node.discovery.alive_members()],
@@ -146,7 +148,9 @@ class GossipService:
                         halt.wait(backoff)
                         backoff = min(2.0, backoff * 2)
 
-            t = threading.Thread(target=run, daemon=True)
+            t = RegisteredThread(target=run,
+                                 name="gossip-deliver-restart",
+                                 structure="gossip.service")
             self._client_thread = t
             t.start()
 

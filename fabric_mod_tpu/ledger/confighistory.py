@@ -22,6 +22,10 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.observability.logging import get_logger
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
+
+log = get_logger("ledger.confighistory")
 
 LIFECYCLE_NS = "_lifecycle"
 
@@ -55,7 +59,7 @@ class ConfigHistoryManager:
     def __init__(self, path: Optional[str] = None):
         self._path = path
         self._since_sp_write = 0
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("ledger.confighistory._lock")
         # ns -> sorted [(block_num, collections bytes)]
         self._by_ns: Dict[str, List[Tuple[int, bytes]]] = {}
         self._listeners: List[Callable] = []
@@ -158,8 +162,9 @@ class ConfigHistoryManager:
             for cb in self._listeners:
                 try:
                     cb(ev)
-                except Exception:
-                    pass                   # listeners must not wedge commit
+                except Exception as e:     # listeners must not wedge commit
+                    log.debug("config-history listener raised: "
+                              "%r", e)
 
     # -- queries (reference: confighistory retriever) --------------------
     def most_recent_collection_config_below(

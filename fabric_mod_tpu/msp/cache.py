@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import threading
 from typing import Any, Callable, Dict, Optional
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 
 class SecondChanceCache:
@@ -16,7 +17,7 @@ class SecondChanceCache:
 
     def __init__(self, capacity: int = 256):
         self.capacity = capacity
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("msp.cache._lock")
         self._data: Dict[Any, list] = {}    # key -> [value, referenced]
         self._ring: list = []
         self._hand = 0
@@ -101,7 +102,7 @@ class LocalMspRegistry:
     (reference: msp/mgmt/mspmgmt.go)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("msp.registry._lock")
         self._local: Optional[Any] = None
         self._chains: Dict[str, Any] = {}
 

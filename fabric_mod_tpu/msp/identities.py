@@ -9,7 +9,6 @@ check as a VerifyItem so callers can batch instead.
 from __future__ import annotations
 
 import hashlib
-import os
 from typing import Optional
 
 try:
@@ -24,6 +23,7 @@ except ImportError:
 from fabric_mod_tpu.bccsp.api import BCCSP, VerifyItem
 from fabric_mod_tpu.bccsp import sw as swlib
 from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.utils import knobs
 
 
 def fused_hash_enabled() -> bool:
@@ -33,7 +33,7 @@ def fused_hash_enabled() -> bool:
     ECDSA verify (ops/p256.batch_verify_raw) — no host digest loop on
     the block-commit path.  Read per call on purpose (cheap), so tests
     and bench A/B can flip it without rebuilding identities."""
-    return os.environ.get("FABRIC_MOD_TPU_FUSED_HASH", "") == "1"
+    return knobs.get_bool("FABRIC_MOD_TPU_FUSED_HASH")
 
 
 class Identity:

@@ -17,6 +17,7 @@ from typing import Dict, Optional
 
 from fabric_mod_tpu.observability.metrics import (
     MetricOpts, MetricsProvider)
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 ROOT = "fabric_mod_tpu"
 
@@ -25,7 +26,7 @@ _LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
            "error": logging.ERROR, "fatal": logging.CRITICAL,
            "panic": logging.CRITICAL}
 
-_spec_lock = threading.Lock()
+_spec_lock = RegisteredLock("observability.logging._spec_lock")
 _current_spec = "info"
 
 

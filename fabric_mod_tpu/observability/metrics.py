@@ -17,6 +17,7 @@ import math
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 
 class MetricOpts:
@@ -40,7 +41,7 @@ class _Labeled:
     def __init__(self, opts: MetricOpts):
         self.opts = opts
         self._children: Dict[Tuple[str, ...], "_Labeled"] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # fmtlint: allow[locks] -- leaf lock on the per-sample with_labels path, never nested; C-level speed matters
 
     def with_labels(self, *values: str):
         if len(values) != len(self.opts.label_names):
@@ -128,7 +129,7 @@ class MetricsProvider:
     def __init__(self):
         self._metrics: List[_Labeled] = []
         self._named: Dict[Tuple[type, str], _Labeled] = {}
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("observability.metrics._lock")
 
     def new_counter(self, opts: MetricOpts) -> Counter:
         return self._register(Counter(opts))
@@ -208,7 +209,7 @@ class MetricsProvider:
 
 
 _default_provider: Optional[MetricsProvider] = None
-_default_lock = threading.Lock()
+_default_lock = RegisteredLock("observability.metrics._default_lock")
 
 
 def default_provider() -> MetricsProvider:

@@ -17,6 +17,8 @@ from typing import Callable, Dict, Optional
 from fabric_mod_tpu.observability import logging as flog
 from fabric_mod_tpu.observability.metrics import (
     MetricsProvider, default_provider)
+from fabric_mod_tpu.concurrency.threads import RegisteredThread
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 VERSION = "0.3.0"
 
@@ -26,7 +28,7 @@ class HealthRegistry:
 
     def __init__(self):
         self._checkers: Dict[str, Callable[[], None]] = {}
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("observability.opsserver._lock")
 
     def register(self, name: str, checker: Callable[[], None]) -> None:
         with self._lock:
@@ -49,7 +51,7 @@ class HealthRegistry:
 
 
 _default_health: Optional[HealthRegistry] = None
-_default_health_lock = threading.Lock()
+_default_health_lock = RegisteredLock("observability.opsserver._default_health_lock")
 
 
 def default_health() -> HealthRegistry:
@@ -217,8 +219,9 @@ class OperationsServer:
             self._httpd.socket = ctx.wrap_socket(
                 self._httpd.socket, server_side=True)
         self.addr = self._httpd.server_address
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
+        self._thread = RegisteredThread(
+            target=self._httpd.serve_forever, name="opsserver-http",
+            structure="observability.opsserver")
 
     def start(self) -> None:
         self._thread.start()

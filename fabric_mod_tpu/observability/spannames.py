@@ -1,0 +1,38 @@
+"""The span-name registry: every tracing span, declared here.
+
+Span names are the join key of the whole observability layer — the
+per-block timelines, the ``fabric_trace_substage_seconds{stage}``
+metric, the bench sub-span attribution that must explain the engine's
+stage/await/commit buckets, and the Perfetto export all select spans
+BY NAME.  A typo'd name in a new ``tracing.span("...")`` call would
+silently fall out of every one of those views; the fmtlint
+``span-names`` rule requires each literal to be declared here (and
+each declaration to be used by a production seam), so the set of
+stages is a reviewed, documented surface instead of an accident of
+string literals.
+"""
+from __future__ import annotations
+
+from typing import Set
+
+# Keep sorted; the lint rule cross-checks both directions.
+DECLARED_SPANS: Set[str] = {
+    "broadcast.handle",
+    "broadcast.submit",
+    "der_marshal",
+    "device_dispatch",
+    "fingerprint",
+    "gossip.drain",
+    "ledger_write",
+    "mvcc",
+    "policy_eval",
+    "recv",
+    "unpack",
+    "verdict_await",
+    "verify.flush",
+    "verify.resolve",
+}
+
+
+def is_declared(name: str) -> bool:
+    return name in DECLARED_SPANS

@@ -60,10 +60,12 @@ from typing import Dict, List, Optional, Tuple
 
 from fabric_mod_tpu.observability.metrics import (MetricOpts,
                                                   default_provider)
+from fabric_mod_tpu.utils import knobs
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 # -- the arming gate (mirrors concurrency.core / faults.core) ---------------
 
-_enabled = os.environ.get("FMT_TRACE", "") not in ("", "0")
+_enabled = knobs.get_bool("FMT_TRACE")
 
 
 def armed() -> bool:
@@ -100,15 +102,12 @@ def set_clock(fn) -> None:
 
 # -- ring bounds ------------------------------------------------------------
 
-def _ring(env: str, default: int) -> int:
-    try:
-        return max(8, int(os.environ.get(env, str(default))))
-    except ValueError:
-        return default
+def _ring(env: str) -> int:
+    return max(8, knobs.get_int(env))
 
 
-SPAN_RING = _ring("FMT_TRACE_SPANS", 2048)
-FLIGHT_RING = _ring("FMT_TRACE_RING", 256)
+SPAN_RING = _ring("FMT_TRACE_SPANS")
+FLIGHT_RING = _ring("FMT_TRACE_RING")
 
 _SUBSTAGE_OPTS = MetricOpts(
     "fabric", "trace", "substage_seconds",
@@ -373,7 +372,7 @@ class Recorder:
     _DUMP_MIN_INTERVAL_S = 5.0
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("observability.tracing._lock")
         self._spans: collections.deque = collections.deque(
             maxlen=SPAN_RING)
         self._timelines: collections.deque = collections.deque(
@@ -563,7 +562,7 @@ def export_chrome_trace(path: str) -> int:
 
 # -- device lens: compile counter + one-shot jax.profiler window ------------
 
-_compile_lock = threading.Lock()
+_compile_lock = RegisteredLock("observability.tracing._compile_lock")
 _compile_installed = False
 _compile_count = 0
 
@@ -608,11 +607,11 @@ def jax_profile_dir() -> Optional[str]:
     capture window around a device batch dispatch (the tpu_watcher
     matrix sets it so the first hardware run leaves a real device
     profile behind)."""
-    got = os.environ.get("FMT_TRACE_JAX_PROFILE", "")
+    got = knobs.get_str("FMT_TRACE_JAX_PROFILE")
     return got or None
 
 
-_profile_lock = threading.Lock()
+_profile_lock = RegisteredLock("observability.tracing._profile_lock")
 _profile_taken = False
 
 

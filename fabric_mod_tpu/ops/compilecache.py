@@ -28,13 +28,13 @@ def enable_compile_cache() -> None:
         return
     try:
         import jax
-        cache_dir = os.environ.get(
-            "FABRIC_MOD_TPU_JIT_CACHE",
-            os.path.expanduser("~/.cache/fabric_mod_tpu/jit"))
+        from fabric_mod_tpu.utils import knobs
+        cache_dir = os.path.expanduser(
+            knobs.get_str("FABRIC_MOD_TPU_JIT_CACHE"))
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         _enabled = True
-    except Exception:
+    except Exception:  # fmtlint: allow[swallowed-exceptions] -- wheel-less or read-only host: the persistent compile cache is best-effort by design
         pass

@@ -38,7 +38,6 @@ operands.
 from __future__ import annotations
 
 import functools
-import os
 from typing import List, Tuple
 
 import numpy as np
@@ -473,7 +472,8 @@ def _use_split_finalexp() -> bool:
     differential runs exactly this split), so the split is the default
     off-chip.  On TPU the fused program is the performance path;
     FABRIC_MOD_TPU_SPLIT_FINALEXP=0/1 overrides either way for A/B."""
-    env = os.environ.get("FABRIC_MOD_TPU_SPLIT_FINALEXP", "")
+    from fabric_mod_tpu.utils import knobs
+    env = knobs.get_str("FABRIC_MOD_TPU_SPLIT_FINALEXP")
     if env in ("0", "1"):
         return env == "1"
     import jax

@@ -68,7 +68,6 @@ HALF = BASE // 2  # rounding offset
 # `set_precision_mode("high")` in its measurement worker; nothing else
 # may.  (ADVICE r5: the old FABRIC_MOD_TPU_PRECISION env var switched
 # every deployment that inherited it, with no runtime guard.)
-import os as _os
 import sys as _sys
 
 PRECISION = jax.lax.Precision.HIGHEST
@@ -100,7 +99,9 @@ def set_precision_mode(mode: str) -> str:
     return prev
 
 
-if _os.environ.get("FABRIC_MOD_TPU_PRECISION", "").lower() == "high":
+from fabric_mod_tpu.utils import knobs as _knobs
+
+if _knobs.get_str("FABRIC_MOD_TPU_PRECISION").lower() == "high":
     # The env var is no longer honored here (it used to silently change
     # verify semantics in any process that inherited it).  The bench
     # worker translates it via set_precision_mode; everyone else gets
@@ -322,8 +323,7 @@ def set_unroll_low_carry(flag: bool) -> None:
 
 
 # env default lets bench variants A/B this without code changes
-_UNROLL_DEFAULT = _os.environ.get(
-    "FABRIC_MOD_TPU_UNROLL_LOW_CARRY", "") == "1"
+_UNROLL_DEFAULT = _knobs.get_bool("FABRIC_MOD_TPU_UNROLL_LOW_CARRY")
 
 
 def get_unroll_low_carry() -> bool:

@@ -768,8 +768,8 @@ def _use_mixed() -> bool:
     both set, the VMEM-fused Pallas kernel runs the mixed-addition
     schedule (ops/p256_pallas.pallas_ladder_mixed) — no longer routed
     around it."""
-    import os
-    return os.environ.get("FABRIC_MOD_TPU_MIXED_ADD", "") == "1"
+    from fabric_mod_tpu.utils import knobs
+    return knobs.get_bool("FABRIC_MOD_TPU_MIXED_ADD")
 
 
 def _use_pallas() -> bool:
@@ -778,8 +778,8 @@ def _use_pallas() -> bool:
     on-chip measurement confirms it over the XLA ladder.  No-op on the
     CPU backend (compiled pallas_call is TPU-only; the interpreter is
     for tests)."""
-    import os
-    if os.environ.get("FABRIC_MOD_TPU_PALLAS", "") != "1":
+    from fabric_mod_tpu.utils import knobs
+    if not knobs.get_bool("FABRIC_MOD_TPU_PALLAS"):
         return False
     return jax.default_backend() != "cpu"
 

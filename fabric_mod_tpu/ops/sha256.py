@@ -137,5 +137,6 @@ def sha256_many(msgs) -> np.ndarray:
     if not msgs:
         return np.zeros((0, 32), np.uint8)
     words, nb = pad_messages(msgs)
+    # fmtlint: allow[jax-hot-path] -- sha256_many is the host-facing one-shot API; the fused commit path uses sha256_blocks directly inside verify_core_fused
     return digest_to_bytes(np.asarray(
         sha256_blocks(jnp.asarray(words), jnp.asarray(nb))))

@@ -61,7 +61,8 @@ from typing import Dict, Optional, Tuple
 from fabric_mod_tpu import faults
 from fabric_mod_tpu.observability.metrics import (MetricOpts,
                                                   default_provider)
-from fabric_mod_tpu.utils.env import env_float, env_int
+from fabric_mod_tpu.utils import knobs
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 # ---------------------------------------------------------------------------
 # knobs
@@ -72,27 +73,27 @@ def submit_queue_cap() -> int:
     """FABRIC_MOD_TPU_SUBMIT_QUEUE: consenter ingress queue bound with
     non-blocking puts; 0/unset keeps the blocking 10k-queue PR 6
     behavior."""
-    return max(0, env_int("FABRIC_MOD_TPU_SUBMIT_QUEUE", 0))
+    return max(0, knobs.get_int("FABRIC_MOD_TPU_SUBMIT_QUEUE"))
 
 
 def ingress_rate() -> float:
     """FABRIC_MOD_TPU_INGRESS_RATE: per-client sustained tokens/s; 0
     disables the limiter."""
-    return max(0.0, env_float("FABRIC_MOD_TPU_INGRESS_RATE", 0.0))
+    return max(0.0, knobs.get_float("FABRIC_MOD_TPU_INGRESS_RATE"))
 
 
 def ingress_burst(rate: float) -> float:
     """FABRIC_MOD_TPU_INGRESS_BURST: bucket capacity (burst size);
     default 2x the rate, floor 1."""
-    return max(1.0, env_float("FABRIC_MOD_TPU_INGRESS_BURST",
+    return max(1.0, knobs.get_float("FABRIC_MOD_TPU_INGRESS_BURST",
                               max(1.0, 2.0 * rate)))
 
 
 def shed_watermarks() -> Tuple[float, float]:
     """FABRIC_MOD_TPU_SHED_HIGH / FABRIC_MOD_TPU_SHED_LOW: submit-queue
     occupancy fractions that open/close the overload gate."""
-    high = min(1.0, max(0.0, env_float("FABRIC_MOD_TPU_SHED_HIGH", 0.9)))
-    low = min(high, max(0.0, env_float("FABRIC_MOD_TPU_SHED_LOW", 0.6)))
+    high = min(1.0, max(0.0, knobs.get_float("FABRIC_MOD_TPU_SHED_HIGH")))
+    low = min(high, max(0.0, knobs.get_float("FABRIC_MOD_TPU_SHED_LOW")))
     return high, low
 
 
@@ -100,7 +101,7 @@ def shed_latency_s() -> float:
     """FABRIC_MOD_TPU_SHED_LAT_S: admission-latency EWMA (seconds) that
     opens the gate even below the occupancy watermark; 0 disables the
     latency trigger."""
-    return max(0.0, env_float("FABRIC_MOD_TPU_SHED_LAT_S", 0.0))
+    return max(0.0, knobs.get_float("FABRIC_MOD_TPU_SHED_LAT_S"))
 
 
 def enabled() -> bool:
@@ -260,7 +261,7 @@ class ClientRateLimiter:
         self._clock = clock or time
         self._max = max(1, max_clients)
         self._buckets: "OrderedDict[str, TokenBucket]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("orderer.admission.ClientRateLimiter._lock")
         self._throttled = 0                # buckets with throttles > 0
         newcomer_rate = rate * self.NEWCOMER_SCALE
         self._newcomers = TokenBucket(
@@ -335,7 +336,7 @@ class OverloadGate:
         self._ewma = 0.0
         self._stamp = self._clock.monotonic()
         self._open = False
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("orderer.admission.OverloadGate._lock")
 
     @property
     def is_open(self) -> bool:
@@ -424,7 +425,7 @@ class AdmissionController:
         self._clock = clock or time
         self._template = gate
         self._gates: Dict[str, OverloadGate] = {}
-        self._gates_lock = threading.Lock()
+        self._gates_lock = RegisteredLock("orderer.admission._gates_lock")
         if gate is not None:
             self._gates[gate.channel] = gate
 
@@ -549,7 +550,7 @@ def classify(env, is_config_update: bool = False,
             if sh.creator:
                 client = hashlib.sha256(
                     sh.creator).hexdigest()[:16]
-        except Exception:
+        except Exception:  # fmtlint: allow[swallowed-exceptions] -- malformed signature header: classify as the shared anonymous client; the processor rejects the envelope with a typed error later
             pass
     priority = is_config_update or \
         ch.type != m.HeaderType.ENDORSER_TRANSACTION
@@ -558,7 +559,7 @@ def classify(env, is_config_update: bool = False,
             ext = m.ChaincodeHeaderExtension.decode(ch.extension)
             priority = (ext.chaincode_id is not None
                         and ext.chaincode_id.name == "_lifecycle")
-        except Exception:
+        except Exception:  # fmtlint: allow[swallowed-exceptions] -- malformed extension: not priority traffic; the processor surfaces the real decode error
             pass
     return client, priority
 

@@ -20,6 +20,7 @@ from typing import Optional, Sequence
 from fabric_mod_tpu.ledger.blkstorage import BlockStore
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 
 def block_signed_data(block: m.Block, md_value: bytes,
@@ -41,7 +42,7 @@ class BlockWriter:
         self._store = store
         self._signer = signer
         self.channel_id = channel_id
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("orderer.blockwriter._lock")
         self.height_changed = threading.Condition()
         # Recover last-config pointer from the tip (reference:
         # blockwriter newBlockWriter reads lastConfigBlockNum)

@@ -38,7 +38,7 @@ from fabric_mod_tpu.orderer.consensus import NotLeaderError
 from fabric_mod_tpu.orderer.msgprocessor import MsgRejectedError
 from fabric_mod_tpu.orderer.registrar import Registrar
 from fabric_mod_tpu.protos import messages as m
-from fabric_mod_tpu.utils.env import env_float
+from fabric_mod_tpu.utils import knobs
 from fabric_mod_tpu.utils.retry import Retrier
 
 # client-attributable rejections -> BAD_REQUEST on the wire; anything
@@ -47,12 +47,11 @@ from fabric_mod_tpu.utils.retry import Retrier
 _CLIENT_FAULTS = (MsgRejectedError, ConfigTxError, ValueError)
 
 
-def broadcast_retry_s(default: float = 5.0) -> float:
+def broadcast_retry_s() -> float:
     """FABRIC_MOD_TPU_BROADCAST_RETRY_S: how long submit() retries a
     leaderless consenter before surfacing NotLeaderError; 0 disables
     (every NotLeaderError is immediate — the pre-retry behavior)."""
-    return max(0.0, env_float("FABRIC_MOD_TPU_BROADCAST_RETRY_S",
-                              default))
+    return max(0.0, knobs.get_float("FABRIC_MOD_TPU_BROADCAST_RETRY_S"))
 
 
 class BroadcastError(Exception):

@@ -24,6 +24,8 @@ from typing import Dict, List, Optional, Tuple
 
 from fabric_mod_tpu.orderer.consensus import ChainHaltedError
 from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.concurrency.threads import RegisteredThread
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 _NORMAL, _CONFIG, _TTC = 0, 1, 2
 
@@ -37,7 +39,7 @@ class Broker:
         self._dir = dir_path
         self._topics: Dict[str, List[bytes]] = {}
         self._files: Dict[str, object] = {}
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("orderer.broker._lock")
         self._cv = threading.Condition(self._lock)
         if dir_path:
             os.makedirs(dir_path, exist_ok=True)
@@ -126,8 +128,10 @@ class BrokerChain:
         self._support = support
         self._topic = topic or support.channel_id
         self._halted = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._timer_lock = threading.Lock()
+        self._thread = RegisteredThread(
+            target=self._run, name=f"broker-chain[{self._topic}]",
+            structure="orderer.broker")
+        self._timer_lock = RegisteredLock("orderer.broker._timer_lock")
         self._timer: Optional[threading.Timer] = None
         # resume: the offset recorded in the tip block's metadata is
         # the last offset INCLUDED in a block — everything after it
@@ -185,6 +189,7 @@ class BrokerChain:
                 if not self._halted.is_set():
                     self._broker.append(self._topic,
                                         _encode(_TTC, b"", next_block))
+            # fmtlint: allow[threads] -- one-shot batch-timeout Timer, cancelled under _timer_lock on halt; RegisteredThread has no delayed-start analog
             self._timer = threading.Timer(
                 self._support.batch_timeout_s(), fire)
             self._timer.daemon = True

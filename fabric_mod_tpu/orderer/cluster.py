@@ -25,6 +25,11 @@ from fabric_mod_tpu.comm.grpc_comm import (
     GRPCClient, GRPCServer, MethodKind)
 from fabric_mod_tpu.orderer import raft
 from fabric_mod_tpu.orderer import raftchain
+from fabric_mod_tpu.concurrency.threads import RegisteredThread
+from fabric_mod_tpu.observability.logging import get_logger
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
+
+log = get_logger("orderer.cluster")
 
 
 def _b64(b: bytes) -> str:
@@ -107,7 +112,7 @@ class GRPCRaftTransport:
         self.node_id = node_id
         self._peers = dict(peers)
         self._handlers: Dict[str, Callable] = {}
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("orderer.cluster._lock")
         self._stopped = threading.Event()
         self._client_tls = (client_ca, client_cert, client_key)
         # per-destination bounded queues + sender threads: a dead peer
@@ -180,8 +185,9 @@ class GRPCRaftTransport:
             return
         try:
             handler(src, decode_msg(raw))
-        except Exception:
-            pass
+        except Exception as e:
+            log.debug("cluster step handler for %s failed: %r",
+                      dst, e)
 
     def _queue_for(self, base: str) -> "queue.Queue":
         with self._lock:
@@ -189,8 +195,10 @@ class GRPCRaftTransport:
             if q is None:
                 q = queue.Queue(self.QUEUE_CAP)
                 self._queues[base] = q
-                t = threading.Thread(target=self._sender, args=(base, q),
-                                     daemon=True)
+                t = RegisteredThread(target=self._sender,
+                                     args=(base, q),
+                                     name=f"cluster-sender[{base}]",
+                                     structure="orderer.cluster")
                 self._senders[base] = t
                 t.start()
             return q
@@ -235,6 +243,6 @@ class GRPCRaftTransport:
         try:
             d = json.loads(request)
             self._deliver(d["src"], d["dst"], _unb64(d["msg"]))
-        except Exception:
-            pass
+        except Exception as e:
+            log.debug("malformed cluster step request: %r", e)
         return b""

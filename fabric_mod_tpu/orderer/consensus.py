@@ -19,6 +19,7 @@ from typing import Optional
 
 from fabric_mod_tpu.orderer import admission
 from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.concurrency.threads import RegisteredThread
 
 
 class ChainHaltedError(Exception):
@@ -70,7 +71,9 @@ class SoloChain:
         self._q: "queue.Queue[Optional[_Msg]]" = queue.Queue(
             maxsize=cap if self._bounded else 10_000)
         self._halted = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = RegisteredThread(target=self._run,
+                                        name="solo-chain",
+                                        structure="orderer.consensus")
 
     # -- consenter API (reference: consensus.go Order/Configure) ---------
     def start(self) -> None:

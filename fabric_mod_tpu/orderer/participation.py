@@ -24,6 +24,7 @@ from fabric_mod_tpu.channelconfig.configtx import config_from_block
 from fabric_mod_tpu.orderer.consensus import ChainHaltedError
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
+from fabric_mod_tpu.concurrency.threads import RegisteredThread
 
 
 class ParticipationError(Exception):
@@ -56,7 +57,9 @@ class FollowerChain:
         self._is_member = is_member
         self._on_member = on_member
         self._halted = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = RegisteredThread(
+            target=self._run, name="participation",
+            structure="orderer.participation")
 
     # -- consenter surface (order/configure refuse) ----------------------
     def start(self) -> None:

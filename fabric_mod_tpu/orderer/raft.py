@@ -36,6 +36,11 @@ import threading
 import time
 import zlib
 from typing import Callable, Dict, List, Optional, Tuple
+from fabric_mod_tpu.concurrency.threads import RegisteredThread
+from fabric_mod_tpu.observability.logging import get_logger
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
+
+log = get_logger("orderer.raft")
 
 # --- messages (wire-shaped; the gRPC cluster Step carries these) -----------
 
@@ -104,7 +109,7 @@ class RaftTransport:
 
     def __init__(self):
         self._handlers: Dict[str, Callable] = {}
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("orderer.raft._lock")
         self.partitioned: set = set()
 
     def register(self, node_id: str, handler: Callable) -> None:
@@ -119,8 +124,9 @@ class RaftTransport:
         if handler is not None:
             try:
                 handler(src, msg)
-            except Exception:
-                pass
+            except Exception as e:
+                log.debug("raft transport handler %s<-%s "
+                          "failed: %r", dst, src, e)
 
 
 # --- WAL -------------------------------------------------------------------
@@ -349,9 +355,9 @@ class RaftNode:
         # without bound — overflow drops the MESSAGE (raft re-sends;
         # AppendEntries/vote traffic is idempotent-by-protocol) and
         # counts it, the same observability as the chain-level drops
-        from fabric_mod_tpu.utils.env import env_int
+        from fabric_mod_tpu.utils import knobs
         self._q: "queue.Queue" = queue.Queue(
-            maxsize=max(0, env_int("FABRIC_MOD_TPU_RAFT_QUEUE", 8192)))
+            maxsize=max(0, knobs.get_int("FABRIC_MOD_TPU_RAFT_QUEUE")))
         self._stop = threading.Event()
         self._deadline = 0.0
         # pluggable time source: election/heartbeat deadlines are
@@ -375,7 +381,9 @@ class RaftNode:
         # on the FSM thread — a stray cross-thread call raises
         from fabric_mod_tpu.utils.racecheck import ThreadOwnership
         self._fsm_owner = ThreadOwnership(f"raft-fsm[{node_id}]")
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = RegisteredThread(
+            target=self._run, name=f"raft-fsm[{node_id}]",
+            structure="orderer.raft")
         transport.register(node_id, self._on_transport_msg)
 
     # -- queue admission --------------------------------------------------

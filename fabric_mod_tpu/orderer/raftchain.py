@@ -28,6 +28,8 @@ from fabric_mod_tpu.orderer.consensus import ChainHaltedError, NotLeaderError
 from fabric_mod_tpu.orderer.raft import RaftNode, RaftTransport
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
+from fabric_mod_tpu.concurrency.threads import RegisteredThread
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 _log = get_logger("orderer.raftchain")
 
@@ -117,9 +119,11 @@ class RaftChain:
         # past BOTH bounds is truly dropped (counted + logged).
         self._parked: List[_Submit] = []
         self._overflow: "deque[_Submit]" = deque()
-        self._overflow_lock = threading.Lock()
+        self._overflow_lock = RegisteredLock("orderer.raftchain._overflow_lock")
         self._halted = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = RegisteredThread(
+            target=self._run, name="raftchain-run",
+            structure="orderer.raftchain")
         # Applied-index recovery: each block records the raft index of
         # the entry that produced it, so a restart replaying the WAL
         # skips entries already in the block store (otherwise every

@@ -25,6 +25,7 @@ from fabric_mod_tpu.orderer.msgprocessor import (
     MsgRejectedError, StandardChannelProcessor)
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 
 class RegistrarError(Exception):
@@ -39,7 +40,7 @@ class ChainSupport:
         self.channel_id = channel_id
         self.store = store
         self._bundle = bundle
-        self._bundle_lock = threading.Lock()
+        self._bundle_lock = RegisteredLock("orderer.registrar._bundle_lock")
         self._csp = csp
         self.cutter = BlockCutter(bundle.batch_config())
         self.writer = BlockWriter(store, signer, channel_id)
@@ -115,7 +116,7 @@ class Registrar:
         # channel ids being joined/removed right now: reserved so a
         # concurrent join/remove of the same id cannot interleave
         self._busy: set = set()
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("orderer.registrar._lock")
         os.makedirs(root_dir, exist_ok=True)
         # Recover existing channels from disk (reference: Initialize).
         # Directories carrying a .joining marker died mid-onboarding:

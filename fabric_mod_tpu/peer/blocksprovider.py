@@ -33,6 +33,8 @@ from fabric_mod_tpu.observability import get_logger
 from fabric_mod_tpu.orderer.server import SERVICE, make_seek_envelope
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.utils.retry import Retrier
+from fabric_mod_tpu.concurrency.threads import RegisteredThread
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 log = get_logger("peer.blocksprovider")
 
@@ -86,7 +88,7 @@ class FailoverDeliverSource:
             name="deliver.failover")
         self._idx = 0                      # current endpoint
         self._resume: Optional[int] = None  # set by report_bad_block
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("peer.blocksprovider._lock")
         self.rotations = 0                 # observability
 
     def report_bad_block(self, number: int) -> None:
@@ -201,7 +203,7 @@ class FailoverDeliverSource:
                         if stop_event.wait(delay):
                             return
                     else:
-                        time.sleep(delay)
+                        time.sleep(delay)  # fmtlint: allow[clocks] -- stop_event-less caller: wall-clock backoff; the schedule itself is the injectable Retrier
 
 
 class _StreamWatchdog:
@@ -241,8 +243,8 @@ class _StreamWatchdog:
                             continue
                     if self._abandoned.is_set():
                         return
-            except Exception:
-                pass
+            except Exception as e:
+                log.debug("watchdog pump exiting: %r", e)
             while not self._abandoned.is_set():
                 try:
                     q.put(self._DONE, timeout=0.5)
@@ -250,7 +252,8 @@ class _StreamWatchdog:
                 except _queue.Full:
                     continue
 
-        t = threading.Thread(target=pump, daemon=True)
+        t = RegisteredThread(target=pump, name="deliver-pump",
+                             structure="peer.blocksprovider")
         t.start()
         try:
             waited = 0.0

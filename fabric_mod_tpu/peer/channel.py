@@ -27,6 +27,7 @@ from fabric_mod_tpu.peer.txvalidator import (
 from fabric_mod_tpu.policy import ApplicationPolicyEvaluator
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 # Default endorsement policy reference when the namespace has none
 # (reference: lifecycle's default /Channel/Application/Endorsement)
@@ -50,11 +51,11 @@ class Channel:
         self._verifier = verifier
         self._csp = csp
         self._plugin_registry = plugin_registry
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("peer.channel._lock")
         self._commit_pipe = None           # lazy; see commit_pipeline()
         # serializes pipe (re)builds: never held by pipe worker
         # threads, so the unbounded drain-join inside cannot deadlock
-        self._pipe_rebuild_lock = threading.Lock()
+        self._pipe_rebuild_lock = RegisteredLock("peer.channel._pipe_rebuild_lock")
         if vinfo is None:
             # lifecycle-backed: committed chaincode definitions resolve
             # each namespace's endorsement policy (peer/lifecycle.py)

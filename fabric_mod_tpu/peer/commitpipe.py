@@ -48,7 +48,6 @@ from __future__ import annotations
 
 import functools
 import itertools
-import os
 import threading
 import time
 from typing import Callable, List, Optional
@@ -61,6 +60,10 @@ from fabric_mod_tpu.observability import tracing
 from fabric_mod_tpu.observability.metrics import (MetricOpts,
                                                   default_provider)
 from fabric_mod_tpu.observability.opsserver import default_health
+from fabric_mod_tpu.utils import knobs
+from fabric_mod_tpu.observability.logging import get_logger
+
+log = get_logger("peer.commitpipe")
 
 _STAGE_OPTS = MetricOpts(
     "fabric", "commitpipe", "stage_seconds",
@@ -107,11 +110,8 @@ _pipe_seq = itertools.count()
 def pipeline_depth(default: int = 0) -> int:
     """The FABRIC_MOD_TPU_COMMIT_PIPELINE knob: pipeline depth, 0 (or
     unset/garbage) = disabled, i.e. the synchronous commit path."""
-    try:
-        return max(0, int(os.environ.get(
-            "FABRIC_MOD_TPU_COMMIT_PIPELINE", str(default))))
-    except ValueError:
-        return default
+    return max(0, knobs.get_int("FABRIC_MOD_TPU_COMMIT_PIPELINE",
+                                default))
 
 
 class ValidatorCommitTarget:
@@ -259,8 +259,8 @@ class PipelinedCommitter:
         if self._on_error is not None:
             try:
                 self._on_error(e)
-            except Exception:
-                pass
+            except Exception as cb_err:
+                log.debug("on_error callback raised: %r", cb_err)
 
     # -- producer side ---------------------------------------------------
     def submit(self, block) -> None:
@@ -470,5 +470,7 @@ class PipelinedCommitter:
             if self._on_commit is not None:
                 try:
                     self._on_commit(staged.block, flags)
-                except Exception:          # fan-out is advisory
-                    pass
+                except Exception as e:     # fan-out is advisory
+                    log.debug("on_commit fan-out for block %d "
+                              "raised: %r",
+                              staged.block.header.number, e)

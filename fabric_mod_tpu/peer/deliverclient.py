@@ -40,6 +40,9 @@ from fabric_mod_tpu.peer.commitpipe import PipelinedCommitter, pipeline_depth
 from fabric_mod_tpu.peer.mcs import BlockVerificationError
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
+from fabric_mod_tpu.observability.logging import get_logger
+
+log = get_logger("peer.deliverclient")
 
 
 class DeliverDisconnected(Exception):
@@ -114,8 +117,9 @@ class DeliverClient:
         if self._on_commit is not None:
             try:
                 self._on_commit(block)
-            except Exception:              # gossip fan-out is advisory
-                pass
+            except Exception as e:         # gossip fan-out is advisory
+                log.debug("gossip fan-out for block %d raised: "
+                          "%r", block.header.number, e)
 
     # cumulative wall seconds per stage (the e2e bench reports these
     # to show the verify-vs-commit overlap); commit_secs keeps the old

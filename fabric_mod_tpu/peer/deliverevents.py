@@ -55,8 +55,8 @@ def filtered_block(channel_id: str, block: m.Block) -> m.FilteredBlock:
         if ch.type == m.HeaderType.ENDORSER_TRANSACTION:
             try:
                 ftx.transaction_actions = _filtered_actions(payload.data)
-            except Exception:
-                pass                   # malformed tx body: txid+code only
+            except Exception:  # fmtlint: allow[swallowed-exceptions] -- malformed tx body: the filtered event still carries txid+code, which is the contract
+                pass
         ftxs.append(ftx)
     return m.FilteredBlock(channel_id=channel_id,
                            number=block.header.number,

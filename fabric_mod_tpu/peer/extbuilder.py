@@ -36,6 +36,8 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from fabric_mod_tpu.peer.chaincode import ChaincodeError, ChaincodeStub
+from fabric_mod_tpu.concurrency.threads import RegisteredThread
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 
 class ExternalBuilderError(Exception):
@@ -213,7 +215,9 @@ class ChaincodeServer:
         self.address = "%s:%d" % self._srv.server_address
 
     def start(self) -> None:
-        t = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        t = RegisteredThread(target=self._srv.serve_forever,
+                             name="extbuilder-http",
+                             structure="peer.extbuilder")
         t.start()
 
     def stop(self) -> None:
@@ -259,7 +263,7 @@ class ExternalContract:
         self._timeout = timeout_s
         # RLock: the invoke error path closes the connection while
         # already holding the lock
-        self._lock = threading.RLock()
+        self._lock = RegisteredLock("peer.extbuilder.ExternalContract._lock")
         self._sock: Optional[socket.socket] = None
         self._file: Optional[_SockFile] = None
 
@@ -437,7 +441,7 @@ class ChaincodeLauncher:
         self._platforms = platforms or PlatformRegistry()
         self._live: Dict[str, object] = {}
         self._procs: List[subprocess.Popen] = []
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("peer.extbuilder.ChaincodeLauncher._lock")
         self._launch_ctx = LaunchContext(self._procs.append)
 
     def resolve(self, name: str):
@@ -549,6 +553,6 @@ class ChaincodeLauncher:
                 proc.kill()
             try:
                 proc.wait(timeout=5)
-            except Exception:
+            except Exception:  # fmtlint: allow[swallowed-exceptions] -- reaping an already-killed chaincode process is best-effort teardown
                 pass
         self._procs.clear()

@@ -28,6 +28,7 @@ from fabric_mod_tpu.comm.grpc_comm import GRPCClient
 from fabric_mod_tpu.orderer.server import SERVICE, make_seek_envelope
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.utils.retry import Retrier
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 
 class GrpcDeliverSource:
@@ -135,7 +136,7 @@ class GrpcBroadcaster:
         self._redial = redial
         self._sleep = sleep
         self._queue_cap = queue_cap
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("peer.grpcdeliver._lock")
         self._owned: list = []             # redirect-dialed clients
         self._hint_wait = 0.0              # pending retry-after hint
         self.trace_ctx = None              # set when FMT_TRACE is armed
@@ -185,7 +186,7 @@ class GrpcBroadcaster:
             self._owned.remove(self._client)
             try:
                 self._client.close()
-            except Exception:
+            except Exception:  # fmtlint: allow[swallowed-exceptions] -- closing a dead owned client during rotation; the reconnect path is the recovery
                 pass
         self._owned.append(client)
         self._open(client)
@@ -233,7 +234,7 @@ class GrpcBroadcaster:
                 client = None
                 try:
                     client = self._redial(lead)
-                except Exception:
+                except Exception:  # fmtlint: allow[swallowed-exceptions] -- redirect redial failure falls through to the bounded backoff path (client stays None)
                     pass
                 if client is not None:
                     self._reconnect(client)
@@ -254,6 +255,6 @@ class GrpcBroadcaster:
             for client in self._owned:
                 try:
                     client.close()
-                except Exception:
+                except Exception:  # fmtlint: allow[swallowed-exceptions] -- stream teardown: best-effort close of every owned client
                     pass
             del self._owned[:]

@@ -244,8 +244,8 @@ class LifecycleValidationInfo:
                 if d.endorsement_policy:
                     return (d.validation_plugin or "vscc",
                             d.endorsement_policy)
-            except Exception:
-                pass                        # fall through to default
+            except Exception:  # fmtlint: allow[swallowed-exceptions] -- malformed on-ledger definition: fall through to the default vscc policy (the reference does the same)
+                pass
         return "vscc", self._default
 
     def validation_info_for_writes(self, ns: str,

@@ -328,7 +328,7 @@ class TxValidator:
                         if nsrw.namespace == ns
                         for w in m.KVRWSet.decode(nsrw.rwset).writes]
                 return write_aware(ns, keys)
-            except Exception:
+            except Exception:  # fmtlint: allow[swallowed-exceptions] -- malformed rwset: fall back to tx-level VP resolution; decode errors are surfaced by validation itself
                 pass
         return self._vinfo.validation_info(ns)
 

@@ -41,7 +41,7 @@ from fabric_mod_tpu.soak.invariants import InvariantChecker, SoakError
 from fabric_mod_tpu.soak.plan import ChurnPlan
 from fabric_mod_tpu.soak.workload import MixedWorkload
 from fabric_mod_tpu.soak.world import SoakWorld
-from fabric_mod_tpu.utils.env import env_float, env_int
+from fabric_mod_tpu.utils import knobs
 
 log = get_logger("soak.harness")
 
@@ -57,7 +57,7 @@ class SoakConfig:
                  x509_gap_s: Optional[float] = None,
                  idemix_gap_s: Optional[float] = None,
                  fault_p: Optional[float] = None):
-        gap_env = os.environ.get("FMT_SOAK_GAP_TXS", "")
+        gap_env = knobs.get_str("FMT_SOAK_GAP_TXS", "")
         if gap_txs is None and gap_env:
             try:
                 lo, _, hi = gap_env.partition(":")
@@ -65,26 +65,26 @@ class SoakConfig:
             except ValueError:
                 gap_txs = None             # garbage knob: the default
         self.seed = seed if seed is not None else \
-            env_int("FMT_SOAK_SEED", 8)
+            knobs.get_int("FMT_SOAK_SEED")
         self.n_events = n_events if n_events is not None else \
-            env_int("FMT_SOAK_EVENTS", 6)
+            knobs.get_int("FMT_SOAK_EVENTS")
         self.n_channels = n_channels if n_channels is not None else \
-            env_int("FMT_SOAK_CHANNELS", 2)
+            knobs.get_int("FMT_SOAK_CHANNELS")
         self.n_peers = n_peers if n_peers is not None else \
-            env_int("FMT_SOAK_PEERS", 2)
+            knobs.get_int("FMT_SOAK_PEERS")
         self.gap_txs = gap_txs or (4, 9)
         self.recovery_window_s = recovery_window_s \
             if recovery_window_s is not None else \
-            env_float("FMT_SOAK_WINDOW_S", 45.0)
+            knobs.get_float("FMT_SOAK_WINDOW_S")
         self.min_recovery_frac = min_recovery_frac \
             if min_recovery_frac is not None else \
-            env_float("FMT_SOAK_RECOVERY_FRAC", 0.05)
+            knobs.get_float("FMT_SOAK_RECOVERY_FRAC")
         self.x509_gap_s = x509_gap_s if x509_gap_s is not None else \
-            env_float("FMT_SOAK_X509_GAP_S", 0.12)
+            knobs.get_float("FMT_SOAK_X509_GAP_S")
         self.idemix_gap_s = idemix_gap_s if idemix_gap_s is not None \
-            else env_float("FMT_SOAK_IDEMIX_GAP_S", 1.0)
+            else knobs.get_float("FMT_SOAK_IDEMIX_GAP_S")
         self.fault_p = fault_p if fault_p is not None else \
-            env_float("FMT_SOAK_FAULT_P", 0.05)
+            knobs.get_float("FMT_SOAK_FAULT_P")
 
 
 def background_fault_plan(seed: int, p: float) -> faults.FaultPlan:
@@ -134,7 +134,7 @@ class SoakHarness:
                 if time.monotonic() > deadline:
                     raise SoakError(
                         f"no raft leader elected on {cid}", self.plan)
-                time.sleep(0.05)
+                time.sleep(0.05)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
 
     def _fire(self, world: SoakWorld, kind: str) -> Dict:
         """Execute one churn event; returns event-specific context the
@@ -234,7 +234,7 @@ class SoakHarness:
                     f"traffic stalled during {label}: "
                     f"{workload.counts()['x509'] - c0}/{gap_txs} txs "
                     f"in {budget:.0f}s", self.plan)
-            time.sleep(0.05)
+            time.sleep(0.05)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
         return gap_txs / max(1e-9, time.monotonic() - t0)
 
     # -- the run -----------------------------------------------------------
@@ -307,7 +307,7 @@ class SoakHarness:
         finally:
             try:
                 workload.stop()
-            except Exception:
+            except Exception:  # fmtlint: allow[swallowed-exceptions] -- finally-block teardown: a stop() failure must not mask the run's SoakError
                 pass
             world.close()
             checker.close_health()

@@ -122,7 +122,7 @@ class InvariantChecker:
                     last, last_t = tip, time.monotonic()
                 elif time.monotonic() - last_t >= 0.4:
                     return tip
-            time.sleep(0.05)
+            time.sleep(0.05)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
         sups = self.world.supports(cid)
         raise SoakError(
             f"orderer tips on {cid} did not stabilize within the "
@@ -185,7 +185,7 @@ class InvariantChecker:
                             f"{cid} within {window:.1f}s (tip {tip}): "
                             f"heights={[(p.name, p.height(cid)) for p in self.world.peers]}",
                             self.plan)
-                    time.sleep(0.05)
+                    time.sleep(0.05)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
         finally:
             self.workload.resume()
         rec = time.monotonic() - t0
@@ -271,7 +271,7 @@ class InvariantChecker:
                       if t not in self._thread_baseline]
             if not leaked:
                 return
-            time.sleep(0.1)
+            time.sleep(0.1)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
         names = sorted(f"{t.structure}:{t.name}" for t in leaked)
         raise SoakError(
             f"{len(leaked)} worker thread(s) leaked at soak teardown: "

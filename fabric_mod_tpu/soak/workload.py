@@ -36,13 +36,14 @@ from fabric_mod_tpu.observability import get_logger
 from fabric_mod_tpu.peer.endorser import endorse_and_submit
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 log = get_logger("soak.workload")
 
 _FIXTURE_PATH = os.path.join(os.path.dirname(__file__),
                              "idemix_fixture.json")
 _fixture_cache: Optional[dict] = None
-_fixture_lock = threading.Lock()
+_fixture_lock = RegisteredLock("soak.workload._fixture_lock")
 
 
 def load_idemix_fixture() -> dict:
@@ -101,7 +102,7 @@ class MixedWorkload:
         self._gate = threading.Event()
         self._gate.set()
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("soak.workload._lock")
         self._busy = 0
         # cid -> {txid: encoded envelope} — retained for the
         # resubmit-at-tail path of the exactly-once audit
@@ -129,7 +130,7 @@ class MixedWorkload:
             with self._lock:
                 if self._busy == 0:
                     return
-            time.sleep(0.01)
+            time.sleep(0.01)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
         raise RuntimeError("workload did not quiesce in time")
 
     def resume(self) -> None:
@@ -159,7 +160,7 @@ class MixedWorkload:
                     with self._lock:
                         self.submit_errors += 1
                     log.debug("x509 submit retryable failure: %s", e)
-                    time.sleep(0.1)
+                    time.sleep(0.1)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
             self._stop.wait(self._x509_gap)
 
     def _make_and_submit(self, cid: str, i: int, bcast):

@@ -63,6 +63,7 @@ from fabric_mod_tpu.peer.endorser import Endorser
 from fabric_mod_tpu.peer.scc import build_default_registry
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.utils.fakeclock import ManualClock
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
 log = get_logger("soak.world")
 
@@ -98,17 +99,17 @@ class _FailoverSource:
                 return
             sup = self._world.pick_deliver_support(self._cid, num)
             if sup is None:
-                time.sleep(0.05)
+                time.sleep(0.05)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
                 continue
             try:
                 for blk in DeliverService(sup).blocks(
                         num, stop, stop_event=stop_event, timeout_s=1.0):
                     yield blk
                     num = blk.header.number + 1
-            except Exception:
+            except Exception as e:
                 # injected mid-stream fault or a dying orderer: the
                 # rotation below is the tolerance mechanism under test
-                pass
+                log.debug("soak deliver stream rotating: %r", e)
             self.rotations += 1
 
 
@@ -187,7 +188,7 @@ class _Subscriber:
         self.received: List[int] = []
         self.status: Optional[int] = None
         self.error: Optional[Exception] = None
-        self._thread = threading.Thread(target=self._run,
+        self._thread = RegisteredThread(target=self._run,
                                         name="soak-audit-subscriber",
                                         daemon=True)
         self._thread.start()
@@ -229,7 +230,7 @@ class SoakWorld:
         self._clock_interval = clock_interval
         self._pump_stop = threading.Event()
         self._pump: Optional[RegisteredThread] = None
-        self._lock = threading.Lock()
+        self._lock = RegisteredLock("soak.world._lock")
         self._batch_counts: Dict[str, int] = {}
         self._rr = 0
 
@@ -398,7 +399,7 @@ class SoakWorld:
             if sup is not None:
                 try:
                     sup.chain.halt()
-                except Exception:
+                except Exception:  # fmtlint: allow[swallowed-exceptions] -- leader-kill chaos event: halting an already-dying chain is best-effort
                     pass
 
     # -- config events -----------------------------------------------------
@@ -426,7 +427,7 @@ class SoakWorld:
                 return
             except Exception as e:         # noqa: BLE001
                 last = e
-                time.sleep(0.25)
+                time.sleep(0.25)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
         raise RuntimeError(
             f"config update on {cid} failed after retries: {last}")
 
@@ -438,7 +439,7 @@ class SoakWorld:
             if sups and all(s.bundle().sequence >= seq
                             for s in sups.values()):
                 return
-            time.sleep(0.05)
+            time.sleep(0.05)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
         raise RuntimeError(
             f"config sequence {seq} did not propagate on {cid}: "
             f"{[(o, s.bundle().sequence) for o, s in self.supports(cid).items()]}")
@@ -617,5 +618,5 @@ class SoakWorld:
         for o in orderers:
             try:
                 o.registrar.close()
-            except Exception:
+            except Exception:  # fmtlint: allow[swallowed-exceptions] -- world teardown after chaos: a dead orderer's close must not mask the run's result
                 pass

@@ -1,0 +1,240 @@
+"""The typed knob registry: every env tunable, declared once.
+
+(reference: the role Viper + config structs play in the Go stack —
+every tunable has a declared name, type, default, and doc, so a typo'd
+override fails visibly instead of silently running defaults.  Our
+knobs were stringly-typed `os.environ` reads scattered across 15+
+modules; the fmtlint `knobs` rule now requires every
+``FABRIC_MOD_TPU_*`` / ``FMT_*`` access to go through this registry,
+and the README knob table is cross-checked against it so the docs
+cannot drift.)
+
+Reading an UNDECLARED knob raises ``KeyError`` at call time — the
+static mirror is the fmtlint rule that flags undeclared knob literals
+at lint time.  Parsing is built on :mod:`fabric_mod_tpu.utils.env`
+(malformed values fall back to the default, never crash at import).
+
+Usage::
+
+    from fabric_mod_tpu.utils import knobs
+    depth = knobs.get_int("FABRIC_MOD_TPU_INFLIGHT")      # registry default
+    k     = knobs.get_int("FABRIC_MOD_TPU_BREAKER_K", 3)  # caller override
+    if knobs.get_bool("FABRIC_MOD_TPU_FUSED_HASH"):
+        ...
+
+Boolean semantics are uniform: set-and-not-("", "0") is true.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Union
+
+from fabric_mod_tpu.utils.env import env_float, env_int
+
+Default = Union[int, float, str, bool, None]
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One declared tunable: the registry row the README table and the
+    fmtlint cross-checks are generated from."""
+    name: str
+    type: str                  # "int" | "float" | "str" | "bool"
+    default: Default           # documented default (None = unset/off)
+    doc: str
+
+
+_REGISTRY: Dict[str, Knob] = {}
+
+
+def declare(name: str, type: str, default: Default, doc: str) -> Knob:
+    if type not in ("int", "float", "str", "bool"):
+        raise ValueError(f"knob {name}: unknown type {type!r}")
+    if name in _REGISTRY:
+        raise ValueError(f"knob {name} declared twice")
+    knob = Knob(name, type, default, doc)
+    _REGISTRY[name] = knob
+    return knob
+
+
+def declared() -> Dict[str, Knob]:
+    """Name -> Knob view of the registry (for the lint cross-checks
+    and the generated README table)."""
+    return dict(_REGISTRY)
+
+
+def is_declared(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def _lookup(name: str, want: str) -> Knob:
+    knob = _REGISTRY.get(name)
+    if knob is None:
+        raise KeyError(
+            f"undeclared knob {name!r}: declare it in "
+            f"fabric_mod_tpu/utils/knobs.py (fmtlint rule 'knobs')")
+    if knob.type != want:
+        raise TypeError(
+            f"knob {name} is declared {knob.type}, read as {want}")
+    return knob
+
+
+def get_int(name: str, default: Optional[int] = None) -> int:
+    """Parse an int knob; `default` overrides the registry default for
+    call sites whose fallback is computed at runtime."""
+    knob = _lookup(name, "int")
+    fallback = default if default is not None else knob.default
+    return env_int(name, int(fallback if fallback is not None else 0))
+
+
+def get_float(name: str, default: Optional[float] = None) -> float:
+    knob = _lookup(name, "float")
+    fallback = default if default is not None else knob.default
+    return env_float(name, float(fallback if fallback is not None else 0.0))
+
+
+def get_str(name: str, default: Optional[str] = None) -> str:
+    knob = _lookup(name, "str")
+    fallback = default if default is not None else (knob.default or "")
+    return os.environ.get(name, str(fallback))
+
+
+def get_bool(name: str) -> bool:
+    """Uniform arming semantics: set and not in ("", "0")."""
+    _lookup(name, "bool")
+    return os.environ.get(name, "") not in ("", "0")
+
+
+def knob_table() -> List[Knob]:
+    """Rows for the generated README table, sorted by name."""
+    return sorted(_REGISTRY.values(), key=lambda k: k.name)
+
+
+# ---------------------------------------------------------------------------
+# The registry.  One row per tunable; the README "Knob registry" table
+# is GENERATED from these rows (`python -m fabric_mod_tpu.analysis
+# --knob-table`) and the drift test fails when they diverge.
+# ---------------------------------------------------------------------------
+
+# -- framework arming gates (the FMT_* discipline layer) --------------------
+declare("FMT_RACECHECK", "bool", None,
+        "1 arms every concurrency guard process-wide (race tier); "
+        "unset, each guard is one module-flag read")
+declare("FMT_FAULTS", "str", None,
+        "arm a fault plan process-wide, e.g. "
+        "\"deliver.stream:error@n=3\"; unknown point names and "
+        "malformed rules fail loudly at arm time")
+declare("FMT_TRACE", "bool", None,
+        "1 arms spans + timelines + flight recorder process-wide; "
+        "unset is byte-identical behavior with zero span allocations")
+declare("FMT_TRACE_RING", "int", 256,
+        "flight-recorder ring: block timelines retained")
+declare("FMT_TRACE_SPANS", "int", 2048,
+        "span ring: finished spans retained for /trace + export")
+declare("FMT_TRACE_JAX_PROFILE", "str", None,
+        "directory for the one-shot jax.profiler capture around a "
+        "device batch dispatch (needs FMT_TRACE=1)")
+declare("FMT_SLOW_TESTS", "bool", None,
+        "1 enables the multi-minute eager-pairing differentials in "
+        "the test suite (excluded from tier-1)")
+
+# -- soak harness -----------------------------------------------------------
+declare("FMT_SOAK_SEED", "int", 8,
+        "churn schedule + rng seed (the replay handle)")
+declare("FMT_SOAK_EVENTS", "int", 6, "churn events per run")
+declare("FMT_SOAK_CHANNELS", "int", 2, "soak channels")
+declare("FMT_SOAK_PEERS", "int", 2,
+        "peers at start (join events add more)")
+declare("FMT_SOAK_GAP_TXS", "str", "4:9",
+        "\"lo:hi\" seeded range of txs between churn events")
+declare("FMT_SOAK_WINDOW_S", "float", 45.0,
+        "per-event recovery window (convergence deadline)")
+declare("FMT_SOAK_RECOVERY_FRAC", "float", 0.05,
+        "post/pre-event throughput floor")
+declare("FMT_SOAK_X509_GAP_S", "float", 0.12,
+        "x509 lane inter-tx gap (s)")
+declare("FMT_SOAK_IDEMIX_GAP_S", "float", 1.0,
+        "idemix lane inter-tx gap (s)")
+declare("FMT_SOAK_FAULT_P", "float", 0.05,
+        "background fault probability per injection-point pass")
+
+# -- device / kernel routing ------------------------------------------------
+declare("FABRIC_MOD_TPU_MIXED_ADD", "bool", None,
+        "1 routes bucket verifies through the affine-table "
+        "mixed-addition ladder (RCB alg. 5); dark pending on-chip "
+        "measurement")
+declare("FABRIC_MOD_TPU_PALLAS", "bool", None,
+        "1 selects the VMEM-fused Pallas ladder; composes with "
+        "MIXED_ADD")
+declare("FABRIC_MOD_TPU_FUSED_HASH", "bool", None,
+        "1 makes msp identities emit raw-message verify items: "
+        "SHA-256 on device in the same jitted program as the verify")
+declare("FABRIC_MOD_TPU_PRECISION", "str", None,
+        "bench-scoped ONLY: \"high\" selects the 3-pass limb-matmul "
+        "emulation via set_precision_mode; ignored (with a notice) "
+        "everywhere else")
+declare("FABRIC_MOD_TPU_UNROLL_LOW_CARRY", "bool", None,
+        "1 defaults the unrolled low-carry lane on (bench A/B seam; "
+        "set_unroll_low_carry overrides per thread)")
+declare("FABRIC_MOD_TPU_SPLIT_FINALEXP", "str", None,
+        "0/1 forces the split/fused idemix final-exponentiation "
+        "program; unset = split on the CPU backend, fused on TPU")
+declare("FABRIC_MOD_TPU_JIT_CACHE", "str", "~/.cache/fabric_mod_tpu/jit",
+        "persistent XLA compile-cache directory")
+
+# -- verify front-end -------------------------------------------------------
+declare("FABRIC_MOD_TPU_VERDICT_CACHE", "int", 8192,
+        "verdict memo-cache capacity, LRU over (digest, signature, "
+        "pubkey); 0 disables")
+declare("FABRIC_MOD_TPU_INFLIGHT", "int", 2,
+        "in-flight dispatch window depth of BatchingVerifyService")
+declare("FABRIC_MOD_TPU_VERIFY_DEADLINE", "float", 30.0,
+        "whole-call deadline (s) of BatchingVerifyService.verify/"
+        "verify_many; 0 = wait forever")
+declare("FABRIC_MOD_TPU_BREAKER_K", "int", 3,
+        "consecutive device failures that open the verify circuit; "
+        "0 = never open (per-batch fallback only)")
+declare("FABRIC_MOD_TPU_BREAKER_PROBE_S", "float", 5.0,
+        "background probe period while the circuit is open; 0 "
+        "disables the prober thread")
+
+# -- commit path ------------------------------------------------------------
+declare("FABRIC_MOD_TPU_COMMIT_PIPELINE", "int", 0,
+        "pipeline depth for the gossip drain loop and "
+        "Channel.store_block; 0/unset = synchronous")
+
+# -- ordering / ingress -----------------------------------------------------
+declare("FABRIC_MOD_TPU_BROADCAST_RETRY_S", "float", 5.0,
+        "how long Broadcast.submit retries NotLeaderError before "
+        "surfacing it; 0 = no retry")
+declare("FABRIC_MOD_TPU_SUBMIT_QUEUE", "int", 0,
+        "consenter submit-queue bound + non-blocking puts; 0/unset = "
+        "blocking 10k queue (pre-admission behavior)")
+declare("FABRIC_MOD_TPU_INGRESS_RATE", "float", 0.0,
+        "per-client sustained tokens/s; 0/unset disables the limiter")
+declare("FABRIC_MOD_TPU_INGRESS_BURST", "float", None,
+        "token-bucket capacity (burst size); default 2x rate, min 1")
+declare("FABRIC_MOD_TPU_SHED_HIGH", "float", 0.9,
+        "submit-queue occupancy fraction that opens the overload gate")
+declare("FABRIC_MOD_TPU_SHED_LOW", "float", 0.6,
+        "occupancy fraction that closes the gate (hysteresis band)")
+declare("FABRIC_MOD_TPU_SHED_LAT_S", "float", 0.0,
+        "admission-latency EWMA (s) that opens the gate even below "
+        "the occupancy watermark; 0 = off")
+declare("FABRIC_MOD_TPU_RAFT_QUEUE", "int", 8192,
+        "raft FSM ingress queue bound; overflowed peer messages drop "
+        "counted; 0 = unbounded")
+
+# -- retries / gossip -------------------------------------------------------
+declare("FABRIC_MOD_TPU_RETRY_BASE_S", "float", 0.05,
+        "default base of every Retrier backoff schedule")
+declare("FABRIC_MOD_TPU_RETRY_MAX_S", "float", 5.0,
+        "default cap of every Retrier backoff schedule")
+declare("FABRIC_MOD_TPU_GOSSIP_SEND_RETRIES", "int", 2,
+        "bounded per-message gossip send retries (fresh dial per "
+        "attempt); 0 = drop on first failure")
+
+# -- bench ------------------------------------------------------------------
+declare("FABRIC_MOD_TPU_BENCH_TIMEOUT", "float", 1200.0,
+        "bench worker wall-clock budget (s) per metric")

@@ -22,7 +22,7 @@ from typing import Callable, Optional, Tuple, Type
 
 from fabric_mod_tpu.observability.metrics import (MetricOpts,
                                                   default_provider)
-from fabric_mod_tpu.utils.env import env_float
+from fabric_mod_tpu.utils import knobs
 
 _RETRIES_OPTS = MetricOpts(
     "fabric", "retry", "attempts_total",
@@ -74,9 +74,9 @@ class Retrier:
                  on_retry: Optional[Callable[[BaseException, int], None]]
                  = None, name: str = "retry"):
         self.base_s = (base_s if base_s is not None else
-                       env_float("FABRIC_MOD_TPU_RETRY_BASE_S", 0.05))
+                       knobs.get_float("FABRIC_MOD_TPU_RETRY_BASE_S"))
         self.max_s = (max_s if max_s is not None else
-                      env_float("FABRIC_MOD_TPU_RETRY_MAX_S", 5.0))
+                      knobs.get_float("FABRIC_MOD_TPU_RETRY_MAX_S"))
         if not 0.0 <= jitter < 1.0:
             raise ValueError("jitter must be in [0, 1)")
         self.multiplier = multiplier

@@ -50,8 +50,13 @@ def probe() -> bool:
     return platform is not None and platform != "cpu"
 
 
-# (tag, bench argv, extra env, timeout_s)
+# (tag, bench argv, extra env, timeout_s).  An argv starting with
+# "-m" runs that module instead of bench.py (the fmtlint gate).
 MATRIX = [
+    # tier-0 of the matrix: the fmtlint static gate — a drifted knob
+    # table or an unregistered thread/lock/fault-point on the capture
+    # host fails loudly in the log before any device time is spent
+    ("fmtlint", ["-m", "fabric_mod_tpu.analysis"], {}, 120),
     ("verify_xla", ["--metric", "verify"], {}, 900),
     ("verify_pallas", ["--metric", "verify"],
      {"FABRIC_MOD_TPU_PALLAS": "1"}, 900),
@@ -114,18 +119,37 @@ def run_variant(tag, argv, extra_env, timeout_s):
     env["FABRIC_MOD_TPU_BENCH_PROBE_TIMEOUT"] = "120"
     env["FABRIC_MOD_TPU_BENCH_TIMEOUT"] = str(int(timeout_s - 60))
     env["FABRIC_MOD_TPU_BENCH_ATTEMPTS"] = "1"
-    cmd = [sys.executable, os.path.join(REPO, "bench.py")] + argv
+    if argv and argv[0] == "-m":
+        # gate entries resolve the package from cwd, not the script
+        # path — pin it so a $HOME-launched watcher still finds it
+        cmd = [sys.executable] + argv
+        run_cwd = REPO
+    else:
+        cmd = [sys.executable, os.path.join(REPO, "bench.py")] + argv
+        run_cwd = None
     log(f"run {tag}: {' '.join(argv)} env={extra_env}")
     t0 = time.time()
     logpath = os.path.join(OUTDIR, f"{tag}.log")
     try:
         with open(logpath, "ab") as lf:
             proc = subprocess.run(cmd, env=env, timeout=timeout_s,
+                                  cwd=run_cwd,
                                   stdout=subprocess.PIPE, stderr=lf)
     except subprocess.TimeoutExpired:
         log(f"{tag}: TIMED OUT after {timeout_s}s")
         return None
     dt = time.time() - t0
+    if argv and argv[0] == "-m":
+        # gate entries (fmtlint) emit no bench JSON: pass/fail is the
+        # exit code, findings land in the per-tag log
+        if proc.returncode == 0:
+            log(f"{tag}: clean ({dt:.0f}s)")
+            return None
+        log(f"{tag}: FAILED rc={proc.returncode} — findings in "
+            f"{logpath}")
+        with open(logpath, "ab") as lf:
+            lf.write(proc.stdout)
+        return GATE_FAILED
     for line in reversed(proc.stdout.decode().splitlines()):
         line = line.strip()
         if line.startswith("{"):
@@ -163,10 +187,19 @@ def persist(rec):
         log(f"new best for {rec['metric']}: {rec['value']} ({tag})")
 
 
+# sentinel: a gate entry (fmtlint) failed — abort the capture instead
+# of spending the device-bench budget on a tree that fails the gate
+GATE_FAILED = object()
+
+
 def capture_matrix():
     got_tpu = False
     for tag, argv, env, timeout_s in MATRIX:
         rec = run_variant(tag, argv, env, timeout_s)
+        if rec is GATE_FAILED:
+            log("gate failed; aborting this capture (fix the tree, "
+                "the watcher will retry next interval)")
+            return False
         if rec is not None:
             persist(rec)
             if rec.get("platform") == "tpu":

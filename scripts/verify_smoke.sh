@@ -26,6 +26,13 @@
 # identity assertion fails (bench.py propagates per-metric rc).
 set -euo pipefail
 cd "$(dirname "$0")/.."
+# 00. the static-analysis gate (fmtlint): the repo's runtime
+#     disciplines — knob registry, fault points, span names,
+#     registered threads/locks, injectable clocks, swallowed
+#     exceptions, JAX hot-path purity, README knob-table drift —
+#     checked over the whole package in seconds, BEFORE any test or
+#     bench time is spent; any finding fails the smoke
+JAX_PLATFORMS=cpu python -m fabric_mod_tpu.analysis
 # 0. the race tier's canary slice under FMT_RACECHECK=1: every guard
 #    of fabric_mod_tpu/concurrency armed over the retrofitted
 #    structures (gossip comm senders, the verify-service flusher, the

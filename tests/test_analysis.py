@@ -1,0 +1,515 @@
+"""fmtlint: the static-analysis engine (fabric_mod_tpu/analysis/).
+
+Three layers:
+
+1. Per-rule fixture snippets — one VIOLATING, one CLEAN, one
+   PRAGMA-SUPPRESSED each, run through the engine's real per-module
+   path (`engine.check_module`), so every rule provably fires and
+   every suppression goes through the same pragma filter as the tree
+   gate.
+2. The tier-1 whole-package gate: `engine.run()` over the live tree
+   (incl. the registry cross-checks + README drift) must be clean —
+   this is the "ships clean" acceptance criterion as a test.
+3. The registries the rules are backed by: the typed knob registry
+   (undeclared reads raise), the README knob-table drift checker in
+   both directions, and arm-time FMT_FAULTS plan validation (a typo'd
+   point name raises instead of silently never firing).
+"""
+import textwrap
+
+import pytest
+
+from fabric_mod_tpu import faults
+from fabric_mod_tpu.analysis import docs, engine
+from fabric_mod_tpu.analysis.rules import ALL_RULES, LISTED_RULES
+from fabric_mod_tpu.utils import knobs
+
+RULES_BY_NAME = {r.name: r for r in ALL_RULES}
+
+
+def lint_snippet(tmp_path, source, pkgpath=None):
+    """Run the full rule set over one snippet via the engine's real
+    per-module path.  `pkgpath` overrides the package-relative path the
+    scoped rules (clocks, jax-hot-path) and exemptions key on."""
+    path = tmp_path / "snippet.py"
+    path.write_text(textwrap.dedent(source))
+    known = {r.name for r in ALL_RULES} | {"pragma"}
+    mod = engine.load_module(path, known)
+    if pkgpath is not None:
+        mod.pkgpath = pkgpath
+    ctx = engine.ProjectContext(full_package=False)
+    return engine.check_module(mod, ALL_RULES, ctx)
+
+
+def assert_fires(tmp_path, rule, source, pkgpath=None):
+    findings, _ = lint_snippet(tmp_path, source, pkgpath)
+    assert any(f.rule == rule for f in findings), (
+        f"expected rule {rule!r} to fire; got {findings}")
+
+
+def assert_clean(tmp_path, source, pkgpath=None):
+    findings, _ = lint_snippet(tmp_path, source, pkgpath)
+    assert findings == [], findings
+
+
+def assert_suppressed(tmp_path, source, pkgpath=None):
+    findings, suppressed = lint_snippet(tmp_path, source, pkgpath)
+    assert findings == [], findings
+    assert suppressed >= 1
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: violating / clean / pragma-suppressed
+# ---------------------------------------------------------------------------
+
+class TestKnobRule:
+    def test_violating_raw_environ_read(self, tmp_path):
+        assert_fires(tmp_path, "knobs", """
+            import os
+            depth = os.environ.get("FABRIC_MOD_TPU_INFLIGHT", "2")
+        """)
+
+    def test_violating_os_getenv(self, tmp_path):
+        assert_fires(tmp_path, "knobs", """
+            import os
+            depth = os.getenv("FABRIC_MOD_TPU_INFLIGHT", "2")
+        """)
+        assert_fires(tmp_path, "knobs", """
+            from os import getenv
+            depth = getenv("FMT_TRACE")
+        """)
+
+    def test_violating_environ_subscript(self, tmp_path):
+        assert_fires(tmp_path, "knobs", """
+            import os
+            depth = os.environ["FMT_RACECHECK"]
+        """)
+
+    def test_violating_env_helper_outside_utils(self, tmp_path):
+        assert_fires(tmp_path, "knobs", """
+            from fabric_mod_tpu.utils.env import env_int
+            depth = env_int("FABRIC_MOD_TPU_INFLIGHT", 2)
+        """)
+
+    def test_violating_undeclared_knob_literal(self, tmp_path):
+        assert_fires(tmp_path, "knobs", """
+            from fabric_mod_tpu.utils import knobs
+            depth = knobs.get_int("FABRIC_MOD_TPU_NO_SUCH_KNOB")
+        """)
+
+    def test_clean_registry_read(self, tmp_path):
+        assert_clean(tmp_path, """
+            from fabric_mod_tpu.utils import knobs
+            depth = knobs.get_int("FABRIC_MOD_TPU_INFLIGHT")
+        """)
+
+    def test_suppressed(self, tmp_path):
+        assert_suppressed(tmp_path, """
+            import os
+            x = os.environ.get("FMT_RACECHECK")  # fmtlint: allow[knobs] -- fixture
+        """)
+
+    def test_exempt_in_registry_module(self, tmp_path):
+        assert_clean(tmp_path, """
+            import os
+            x = os.environ.get("FMT_RACECHECK")
+        """, pkgpath="utils/knobs.py")
+
+
+class TestFaultPointRule:
+    def test_violating_undeclared_point(self, tmp_path):
+        assert_fires(tmp_path, "fault-points", """
+            from fabric_mod_tpu import faults
+            faults.point("no.such.point")
+        """)
+
+    def test_violating_non_literal_name(self, tmp_path):
+        assert_fires(tmp_path, "fault-points", """
+            from fabric_mod_tpu import faults
+            def seam(name):
+                faults.point(name)
+        """)
+
+    def test_clean_declared_point(self, tmp_path):
+        assert_clean(tmp_path, """
+            from fabric_mod_tpu import faults
+            faults.point("deliver.stream")
+        """)
+
+    def test_suppressed(self, tmp_path):
+        assert_suppressed(tmp_path, """
+            from fabric_mod_tpu import faults
+            faults.point("no.such.point")  # fmtlint: allow[fault-points] -- fixture
+        """)
+
+
+class TestSpanNameRule:
+    def test_violating_undeclared_span(self, tmp_path):
+        assert_fires(tmp_path, "span-names", """
+            from fabric_mod_tpu.observability import tracing
+            with tracing.span("no_such_span"):
+                pass
+        """)
+
+    def test_clean_declared_span(self, tmp_path):
+        assert_clean(tmp_path, """
+            from fabric_mod_tpu.observability import tracing
+            with tracing.span("mvcc"):
+                pass
+        """)
+
+    def test_suppressed(self, tmp_path):
+        assert_suppressed(tmp_path, """
+            from fabric_mod_tpu.observability import tracing
+            # fmtlint: allow[span-names] -- fixture
+            with tracing.span("no_such_span"):
+                pass
+        """)
+
+
+class TestThreadRule:
+    def test_violating_bare_thread(self, tmp_path):
+        assert_fires(tmp_path, "threads", """
+            import threading
+            t = threading.Thread(target=print)
+        """)
+
+    def test_violating_from_import(self, tmp_path):
+        assert_fires(tmp_path, "threads", """
+            from threading import Timer
+            t = Timer(1.0, print)
+        """)
+
+    def test_clean_registered_thread(self, tmp_path):
+        assert_clean(tmp_path, """
+            from fabric_mod_tpu.concurrency import RegisteredThread
+            t = RegisteredThread(target=print, name="worker")
+        """)
+
+    def test_suppressed(self, tmp_path):
+        assert_suppressed(tmp_path, """
+            import threading
+            t = threading.Thread(target=print)  # fmtlint: allow[threads] -- fixture
+        """)
+
+    def test_exempt_in_concurrency_layer(self, tmp_path):
+        assert_clean(tmp_path, """
+            import threading
+            t = threading.Thread(target=print)
+        """, pkgpath="concurrency/threads.py")
+
+
+class TestLockRule:
+    def test_violating_bare_lock(self, tmp_path):
+        assert_fires(tmp_path, "locks", """
+            import threading
+            lock = threading.Lock()
+        """)
+
+    def test_violating_bare_rlock(self, tmp_path):
+        assert_fires(tmp_path, "locks", """
+            import threading
+            lock = threading.RLock()
+        """)
+
+    def test_clean_registered_lock(self, tmp_path):
+        assert_clean(tmp_path, """
+            from fabric_mod_tpu.concurrency import RegisteredLock
+            lock = RegisteredLock("fixture.lock")
+        """)
+
+    def test_suppressed(self, tmp_path):
+        assert_suppressed(tmp_path, """
+            import threading
+            lock = threading.Lock()  # fmtlint: allow[locks] -- fixture leaf lock
+        """)
+
+
+class TestClockRule:
+    def test_violating_wall_clock_in_scoped_module(self, tmp_path):
+        assert_fires(tmp_path, "clocks", """
+            import time
+            now = time.time()
+        """, pkgpath="utils/retry.py")
+
+    def test_violating_sleep_in_soak(self, tmp_path):
+        assert_fires(tmp_path, "clocks", """
+            import time
+            time.sleep(1.0)
+        """, pkgpath="soak/harness.py")
+
+    def test_clean_monotonic_and_unscoped(self, tmp_path):
+        assert_clean(tmp_path, """
+            import time
+            t0 = time.monotonic()
+        """, pkgpath="utils/retry.py")
+        # wall clock outside the clocked subsystems is out of scope
+        assert_clean(tmp_path, """
+            import time
+            now = time.time()
+        """, pkgpath="cli/node.py")
+
+    def test_suppressed(self, tmp_path):
+        assert_suppressed(tmp_path, """
+            import time
+            now = time.time()  # fmtlint: allow[clocks] -- fixture needs OS time
+        """, pkgpath="utils/retry.py")
+
+
+class TestSwallowRule:
+    def test_violating_except_pass(self, tmp_path):
+        assert_fires(tmp_path, "swallowed-exceptions", """
+            try:
+                work()
+            except Exception:
+                pass
+        """)
+
+    def test_violating_bare_except_pass(self, tmp_path):
+        assert_fires(tmp_path, "swallowed-exceptions", """
+            try:
+                work()
+            except:
+                pass
+        """)
+
+    def test_clean_logged(self, tmp_path):
+        assert_clean(tmp_path, """
+            import logging
+            try:
+                work()
+            except Exception:
+                logging.getLogger(__name__).warning("work failed")
+        """)
+
+    def test_suppressed(self, tmp_path):
+        assert_suppressed(tmp_path, """
+            try:
+                work()
+            except Exception:  # fmtlint: allow[swallowed-exceptions] -- fixture contract
+                pass
+        """)
+
+
+class TestJaxHotPathRule:
+    def test_violating_item_sync(self, tmp_path):
+        assert_fires(tmp_path, "jax-hot-path", """
+            def resolve(verdicts):
+                return verdicts.item()
+        """, pkgpath="ops/p256.py")
+
+    def test_violating_asarray_of_call(self, tmp_path):
+        assert_fires(tmp_path, "jax-hot-path", """
+            import numpy as np
+            def resolve(batch):
+                return np.asarray(compute(batch))
+        """, pkgpath="bccsp/tpu.py")
+
+    def test_violating_block_until_ready(self, tmp_path):
+        assert_fires(tmp_path, "jax-hot-path", """
+            def dispatch(x):
+                return f(x).block_until_ready()
+        """, pkgpath="parallel/mesh.py")
+
+    def test_clean_pure_dispatch(self, tmp_path):
+        assert_clean(tmp_path, """
+            import jax
+            def dispatch(x):
+                return jax.jit(lambda v: v + 1)(x)
+        """, pkgpath="ops/p256.py")
+        # host syncs outside the device-dispatch files are out of scope
+        assert_clean(tmp_path, """
+            def resolve(verdicts):
+                return verdicts.item()
+        """, pkgpath="peer/txvalidator.py")
+
+    def test_suppressed(self, tmp_path):
+        assert_suppressed(tmp_path, """
+            def resolve(verdicts):
+                return verdicts.item()  # fmtlint: allow[jax-hot-path] -- resolve seam
+        """, pkgpath="ops/p256.py")
+
+
+class TestPragmaRule:
+    def test_malformed_pragma_is_a_finding(self, tmp_path):
+        findings, _ = lint_snippet(tmp_path, """
+            x = 1  # fmtlint: suppress this
+        """)
+        assert any(f.rule == "pragma" for f in findings)
+
+    def test_reasonless_pragma_is_a_finding_and_does_not_suppress(
+            self, tmp_path):
+        findings, suppressed = lint_snippet(tmp_path, """
+            import threading
+            lock = threading.Lock()  # fmtlint: allow[locks]
+        """)
+        assert any(f.rule == "pragma" for f in findings)
+        assert any(f.rule == "locks" for f in findings)
+        assert suppressed == 0
+
+    def test_unknown_rule_pragma_is_a_finding(self, tmp_path):
+        findings, _ = lint_snippet(tmp_path, """
+            x = 1  # fmtlint: allow[no-such-rule] -- why
+        """)
+        assert any(f.rule == "pragma" and "no-such-rule" in f.message
+                   for f in findings)
+
+    def test_standalone_pragma_covers_next_line(self, tmp_path):
+        assert_suppressed(tmp_path, """
+            import threading
+            # fmtlint: allow[locks] -- fixture, pragma on its own line
+            lock = threading.Lock()
+        """)
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 whole-package gate
+# ---------------------------------------------------------------------------
+
+def test_whole_package_is_clean():
+    """The acceptance criterion as a test: `python -m
+    fabric_mod_tpu.analysis` (all rules + registry cross-checks +
+    README drift) exits 0 on the tree."""
+    result = engine.run()
+    assert result.ok, "fmtlint findings on the tree:\n" + "\n".join(
+        f.render() for f in result.findings)
+    assert result.files > 100          # really scanned the package
+
+
+def test_every_rule_is_listed():
+    names = {r.name for r in LISTED_RULES}
+    assert {"knobs", "fault-points", "span-names", "threads", "locks",
+            "clocks", "swallowed-exceptions", "jax-hot-path",
+            "pragma"} <= names
+    for rule in LISTED_RULES:
+        assert rule.doc.strip(), f"rule {rule.name} has no doc"
+
+
+def test_cli_list_rules_and_knob_table(capsys):
+    from fabric_mod_tpu.analysis.__main__ import main
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in LISTED_RULES:
+        assert rule.name in out
+    assert main(["--knob-table"]) == 0
+    out = capsys.readouterr().out
+    assert docs.TABLE_BEGIN in out and docs.TABLE_END in out
+
+
+def test_project_check_flags_unused_registry_entries(tmp_path):
+    """A declared-but-unreferenced fault point is drift in the other
+    direction — the whole-package run reports it."""
+    from fabric_mod_tpu.analysis.rules import project_checks
+    with faults.declared_point("synthetic.unused.point"):
+        ctx = engine.ProjectContext(full_package=True)
+        ctx.fault_points_used = set(faults.DECLARED_POINTS) - {
+            "synthetic.unused.point"}
+        from fabric_mod_tpu.observability import spannames
+        ctx.span_names_used = set(spannames.DECLARED_SPANS)
+        findings = project_checks(ctx)
+    assert [f for f in findings
+            if f.rule == "fault-points"
+            and "synthetic.unused.point" in f.message]
+
+
+# ---------------------------------------------------------------------------
+# the knob registry + README drift
+# ---------------------------------------------------------------------------
+
+class TestKnobRegistry:
+    def test_undeclared_read_raises(self):
+        with pytest.raises(KeyError, match="undeclared knob"):
+            knobs.get_int("FABRIC_MOD_TPU_NO_SUCH_KNOB")
+
+    def test_type_mismatch_raises(self):
+        with pytest.raises(TypeError, match="declared str"):
+            knobs.get_int("FMT_FAULTS")
+
+    def test_registry_defaults_and_overrides(self, monkeypatch):
+        monkeypatch.delenv("FABRIC_MOD_TPU_INFLIGHT", raising=False)
+        assert knobs.get_int("FABRIC_MOD_TPU_INFLIGHT") == 2
+        assert knobs.get_int("FABRIC_MOD_TPU_INFLIGHT", 7) == 7
+        monkeypatch.setenv("FABRIC_MOD_TPU_INFLIGHT", "5")
+        assert knobs.get_int("FABRIC_MOD_TPU_INFLIGHT") == 5
+        # malformed values fall back, never crash (utils/env semantics)
+        monkeypatch.setenv("FABRIC_MOD_TPU_INFLIGHT", "wat")
+        assert knobs.get_int("FABRIC_MOD_TPU_INFLIGHT") == 2
+
+    def test_bool_arming_semantics(self, monkeypatch):
+        monkeypatch.delenv("FMT_RACECHECK", raising=False)
+        assert knobs.get_bool("FMT_RACECHECK") is False
+        monkeypatch.setenv("FMT_RACECHECK", "0")
+        assert knobs.get_bool("FMT_RACECHECK") is False
+        monkeypatch.setenv("FMT_RACECHECK", "1")
+        assert knobs.get_bool("FMT_RACECHECK") is True
+
+    def test_double_declaration_raises(self):
+        with pytest.raises(ValueError, match="declared twice"):
+            knobs.declare("FMT_RACECHECK", "bool", None, "dup")
+
+
+class TestReadmeDrift:
+    def test_live_readme_is_in_sync(self):
+        assert docs.check_readme() == []
+
+    def test_missing_declared_knob_is_drift(self):
+        text = docs.render_readme_section().replace(
+            "FABRIC_MOD_TPU_INFLIGHT", "FABRIC_MOD_TPU_INFLIGHTX")
+        findings = docs.check_readme(readme_text=text)
+        assert any("FABRIC_MOD_TPU_INFLIGHT'" in f.message
+                   and "missing from the README" in f.message
+                   for f in findings)
+
+    def test_undeclared_readme_token_is_drift(self):
+        text = (docs.render_readme_section()
+                + "\nprose mentions `FMT_NO_SUCH_KNOB` here\n")
+        findings = docs.check_readme(readme_text=text)
+        assert any("FMT_NO_SUCH_KNOB" in f.message
+                   and "no utils/knobs.py entry" in f.message
+                   for f in findings)
+
+    def test_stale_generated_table_is_drift(self):
+        stale = "\n".join(docs.render_readme_section()
+                          .splitlines()[:-2]            # drop a row
+                          ) + "\n" + docs.TABLE_END
+        findings = docs.check_readme(readme_text=stale)
+        assert any("stale" in f.message or "missing from the README"
+                   in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# FMT_FAULTS arm-time validation (the dynamic mirror of fault-points)
+# ---------------------------------------------------------------------------
+
+class TestFaultPlanValidation:
+    def test_typoed_plan_raises_at_arm_time(self):
+        with pytest.raises(ValueError, match="unknown injection point"):
+            faults.arm_spec("deliver.straem:error@n=1")
+        assert not faults.armed()       # nothing got half-armed
+
+    def test_valid_plan_arms(self):
+        plan = faults.arm_spec("deliver.stream:error@n=1")
+        try:
+            assert faults.armed()
+            assert plan.calls("deliver.stream") == 0
+        finally:
+            faults.disarm()
+
+    def test_validate_passes_declared_points(self):
+        plan = faults.FaultPlan().add("gossip.comm.drop", p=0.5, seed=1)
+        assert plan.validate() is plan
+
+    def test_validate_names_every_unknown_point(self):
+        plan = (faults.FaultPlan()
+                .add("no.such.a", nth=1).add("no.such.b", nth=1))
+        with pytest.raises(ValueError) as ei:
+            plan.validate()
+        assert "no.such.a" in str(ei.value)
+        assert "no.such.b" in str(ei.value)
+
+    def test_scoped_synthetic_declaration(self):
+        with faults.declared_point("synthetic.test.point"):
+            plan = faults.FaultPlan().add("synthetic.test.point", nth=1)
+            assert plan.validate() is plan
+        with pytest.raises(ValueError):
+            plan.validate()             # scope ended, back to unknown
