@@ -380,9 +380,12 @@ def measure_device(items, expect, reps: int) -> float:
     return len(items) * reps / dt
 
 
-def _block_world(n_txs: int):
+def _block_world(n_txs: int, under_endorse_every: int = 0):
     """A 1000-tx-style block world: 3 orgs, 2-of-3 endorsement
-    (BASELINE config #2; reference: txvalidator/v20/validator.go:182)."""
+    (BASELINE config #2; reference: txvalidator/v20/validator.go:182).
+    `under_endorse_every` > 0 endorses every k-th tx by one org only —
+    ENDORSEMENT_POLICY_FAILURE lanes for differentials that must not
+    pass vacuously on an all-VALID block."""
     from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder
     from fabric_mod_tpu.msp import ca as calib
     from fabric_mod_tpu.msp.identities import SigningIdentity
@@ -400,9 +403,13 @@ def _block_world(n_txs: int):
     for i in range(n_txs):
         b = RWSetBuilder()
         b.add_write("mycc", f"key{i}", b"val%d" % i)
+        endorsers = [signers["Org1"], signers["Org2"]]
+        if under_endorse_every and i % under_endorse_every == \
+                under_endorse_every - 1:
+            endorsers = [signers["Org1"]]      # 1-of-3 < 2: must fail
         envs.append(protoutil.create_signed_tx(
             "bench", "mycc", b.build().encode(), signers["client"],
-            [signers["Org1"], signers["Org2"]]))
+            endorsers))
     block = protoutil.new_block(0, b"", envs)
 
     def make_validator(verifier):
@@ -434,7 +441,11 @@ def _three_org_world():
         signers[org] = SigningIdentity(org, cert, calib.key_pem(key), csp)
     policy = m.ApplicationPolicy(signature_policy=from_string(
         "OutOf(2, 'Org1.peer', 'Org2.peer', 'Org3.peer')")).encode()
-    return csp, cas, MspManager(msps), signers, policy
+    # the production channel shape: second-chance caches around the
+    # manager (peer/channel._install_bundle wraps its bundle manager
+    # the same way), so the bench measures the deployed hot path
+    from fabric_mod_tpu.msp.cache import CachedMsp
+    return csp, cas, CachedMsp(MspManager(msps)), signers, policy
 
 
 def _commitpipe_world(n_blocks: int, txs_per_block: int):
@@ -618,6 +629,29 @@ def measure_commitpipe(n_blocks: int, txs_per_block: int, depth: int,
             totals = {k: v["secs"]
                       for k, v in tracing.substage_totals().items()}
 
+        # tensor-policy differential arm: with FABRIC_MOD_TPU_TENSOR_
+        # POLICY armed for the arms above, re-run the sync committer
+        # with the knob scrubbed — per-block txflags and the state
+        # fingerprint must be BIT-IDENTICAL tensor-vs-closure before
+        # any rate is reported (the acceptance oracle)
+        from fabric_mod_tpu.utils import knobs as _kn
+        tensor_armed = _kn.get_bool("FABRIC_MOD_TPU_TENSOR_POLICY")
+        closure_rate = None
+        if tensor_armed:
+            saved_tp = os.environ.pop("FABRIC_MOD_TPU_TENSOR_POLICY")
+            try:
+                with tracing.active(False):
+                    cl_flags, cl_fp, cl_rate = run_sync(tmp + "/closure")
+            finally:
+                os.environ["FABRIC_MOD_TPU_TENSOR_POLICY"] = saved_tp
+            if cl_flags != sync_flags or cl_fp != sync_fp:
+                raise AssertionError(
+                    "tensor-policy verdicts/state diverge from the "
+                    "closure path — the tensor compiler is wrong")
+            closure_rate = cl_rate
+            log(f"tensor-vs-closure differential: identical "
+                f"(closure sync {cl_rate:,.0f} tx/s)")
+
     flags_ok = pipe_flags == sync_flags
     state_ok = pipe_fp == sync_fp
     depth1_ok = d1_flags == sync_flags and d1_fp == sync_fp
@@ -643,14 +677,23 @@ def measure_commitpipe(n_blocks: int, txs_per_block: int, depth: int,
             totals.items())},
     }
     bucket_parts = {
-        "stage": ("unpack", "device_dispatch"),
+        "stage": ("unpack", "device_dispatch", "policy_gather"),
         "await": ("verdict_await",),
-        "commit": ("policy_eval", "mvcc", "ledger_write"),
+        "commit": ("policy_device", "policy_finish", "mvcc",
+                   "ledger_write"),
     }
     for bucket, parts in bucket_parts.items():
         have = sum(totals.get(p, 0.0) for p in parts)
         want = tr_secs[bucket]
-        tol = max(0.10 * want, 0.1)
+        # floor 0.3s: post-r12 the stage/commit buckets are tens of
+        # ms per block, and the engine's bucket timers (but not the
+        # in-thread spans) absorb GIL-scheduling stalls while the
+        # OTHER pipeline thread crunches pure-python ECDSA on the
+        # wheel-less arm — sub-noise buckets must not flake the gate
+        # (a genuinely unattributed NEW sub-stage at that scale is
+        # invisible under any floor; the r09-scale drifts this gate
+        # exists for are seconds, not fractions)
+        tol = max(0.10 * want, 0.3)
         attribution[f"{bucket}_covered"] = round(
             have / want, 3) if want > 1e-9 else 1.0
         if abs(want - have) > tol:
@@ -658,6 +701,13 @@ def measure_commitpipe(n_blocks: int, txs_per_block: int, depth: int,
                 f"stage attribution drifted: {bucket} bucket "
                 f"{want:.3f}s vs sub-span sum {have:.3f}s "
                 f"({'+'.join(parts)}) — tolerance {tol:.3f}s")
+    # the headline the vectorized-policy work is judged by: how much
+    # of the commit bucket is still policy evaluation
+    policy_secs = sum(totals.get(p, 0.0)
+                      for p in ("policy_device", "policy_finish"))
+    commit_secs = max(tr_secs["commit"], 1e-9)
+    attribution["commit_policy_share"] = round(
+        policy_secs / commit_secs, 3)
     # the interesting flags actually flipped (the stream exercised the
     # barrier-dependent verdicts, not just all-VALID blocks) — an
     # all-VALID stream would let the differential pass vacuously
@@ -666,7 +716,7 @@ def measure_commitpipe(n_blocks: int, txs_per_block: int, depth: int,
         raise AssertionError(
             "commitpipe stream produced only VALID flags — the "
             "barrier-dependent verdicts the oracle relies on are gone")
-    return {
+    out = {
         "pipelined_tx_per_sec": round(pipe_rate, 1),
         "sync_tx_per_sec": round(sync_rate, 1),
         "blocks": n_blocks,
@@ -679,6 +729,133 @@ def measure_commitpipe(n_blocks: int, txs_per_block: int, depth: int,
         "depth1_identical": depth1_ok,
         "traced_identical": True,          # asserted above
         "stage_attribution": attribution,
+        "verifier": "sw" if use_sw else "device",
+        "tensor_policy": tensor_armed,
+    }
+    if closure_rate is not None:
+        out["tensor_vs_closure_identical"] = True   # asserted above
+        out["closure_sync_tx_per_sec"] = round(closure_rate, 1)
+    return out
+
+
+def measure_policyeval(n_txs: int, reps: int, use_sw: bool) -> dict:
+    """Tensor-vs-closure policy evaluation A/B over one 2-of-3 block
+    (with deliberate under-endorsed lanes so the verdicts carry
+    signal): the SAME block validated by a closure-path validator and
+    a tensor-path validator, txflags asserted bit-identical BEFORE any
+    rate is reported.  The timed unit is TxValidator.validate — the
+    full stage+finish round including the (shared) verify cost, so the
+    ratio is the honest end-to-end effect, and the substage split
+    shows where the policy milliseconds went."""
+    from fabric_mod_tpu.observability import tracing
+
+    if use_sw:
+        from fabric_mod_tpu.bccsp.sw import SwCSP
+        from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
+        verifier = FakeBatchVerifier(SwCSP())
+    else:
+        from fabric_mod_tpu.bccsp.tpu import TpuVerifier
+        verifier = TpuVerifier(cache_size=0)
+    block, make_validator = _block_world(n_txs, under_endorse_every=16)
+
+    def arm_env(armed: bool):
+        if armed:
+            os.environ["FABRIC_MOD_TPU_TENSOR_POLICY"] = "1"
+        else:
+            os.environ.pop("FABRIC_MOD_TPU_TENSOR_POLICY", None)
+
+    def run_once(validator, armed: bool, traced=False):
+        arm_env(armed)
+        if traced:
+            tracing.recorder().reset()
+            with tracing.active():
+                flags = validator.validate(block)
+                totals = {k: round(v["secs"], 4)
+                          for k, v in tracing.substage_totals().items()}
+            return flags, 0.0, totals
+        t0 = time.perf_counter()
+        flags = validator.validate(block)
+        return flags, time.perf_counter() - t0, None
+
+    saved = os.environ.pop("FABRIC_MOD_TPU_TENSOR_POLICY", None)
+    try:
+        v_closure = make_validator(verifier)
+        v_tensor = make_validator(verifier)
+        closure_flags, _, _ = run_once(v_closure, False)  # warm
+        tensor_flags, _, _ = run_once(v_tensor, True)     # warm
+        # INTERLEAVED min-of-k (the measure_marshal stance): the two
+        # arms alternate so noisy-neighbor slowdowns in the shared
+        # pure-python verify hit both alike — end-to-end tx/s is
+        # verify-bound by design, the ratio must not be machine mood
+        closure_best = tensor_best = float("inf")
+        for _ in range(max(reps, 2)):
+            got, dt, _ = run_once(v_closure, False)
+            closure_best = min(closure_best, dt)
+            if got != closure_flags:
+                raise AssertionError(
+                    "policyeval closure verdicts unstable across reps")
+            got, dt, _ = run_once(v_tensor, True)
+            tensor_best = min(tensor_best, dt)
+            if got != tensor_flags:
+                raise AssertionError(
+                    "policyeval tensor verdicts unstable across reps")
+        # substage split of one traced validate per arm: the POLICY
+        # seconds are the A/B's real subject
+        _, _, closure_tot = run_once(v_closure, False,
+                                     traced=True)
+        _, _, tensor_tot = run_once(v_tensor, True, traced=True)
+        # the session's instance/fallback census from one armed staging
+        arm_env(True)
+        staged = v_tensor.stage(block)
+        v_tensor.finish(staged)
+        session = staged.session
+        instances = len(session) if session is not None else 0
+        fallbacks = session.fallbacks if session is not None else 0
+    finally:
+        if saved is None:
+            os.environ.pop("FABRIC_MOD_TPU_TENSOR_POLICY", None)
+        else:
+            os.environ["FABRIC_MOD_TPU_TENSOR_POLICY"] = saved
+
+    closure_rate = n_txs / closure_best
+    tensor_rate = n_txs / tensor_best
+    POLICY_SPANS = ("policy_gather", "policy_device", "policy_finish")
+    closure_policy_s = sum(closure_tot.get(p, 0.0)
+                           for p in POLICY_SPANS)
+    tensor_policy_s = sum(tensor_tot.get(p, 0.0) for p in POLICY_SPANS)
+    log(f"closure policy eval: {closure_rate:,.0f} validated tx/s, "
+        f"policy {closure_policy_s * 1000:.1f} ms/block")
+    log(f"tensor policy eval: {tensor_rate:,.0f} validated tx/s "
+        f"({tensor_rate / closure_rate:.2f}x), policy "
+        f"{tensor_policy_s * 1000:.1f} ms/block "
+        f"({closure_policy_s / max(tensor_policy_s, 1e-9):.1f}x)")
+
+    # -- the verdict gate (before ANY rate is reported) ------------------
+    if tensor_flags != closure_flags:
+        bad = [i for i, (a, b) in enumerate(zip(tensor_flags,
+                                                closure_flags)) if a != b]
+        raise AssertionError(
+            f"tensor policy verdicts diverge from closures at {bad[:10]}")
+    distinct = sorted(set(closure_flags))
+    if distinct == [0]:
+        raise AssertionError(
+            "policyeval block produced only VALID flags — the "
+            "under-endorsed lanes the oracle relies on are gone")
+
+    return {
+        "tensor_tx_per_sec": round(tensor_rate, 1),
+        "closure_tx_per_sec": round(closure_rate, 1),
+        "policy_secs_closure": round(closure_policy_s, 4),
+        "policy_secs_tensor": round(tensor_policy_s, 4),
+        "policy_speedup": round(
+            closure_policy_s / max(tensor_policy_s, 1e-9), 2),
+        "txs": n_txs,
+        "distinct_flags": distinct,
+        "flags_identical": True,            # asserted above
+        "tensor_instances": instances,
+        "tensor_fallbacks": fallbacks,
+        "substage_secs_tensor": dict(sorted(tensor_tot.items())),
+        "substage_secs_closure": dict(sorted(closure_tot.items())),
         "verifier": "sw" if use_sw else "device",
     }
 
@@ -1279,6 +1456,11 @@ def _worker_metric(args) -> int:
     #   --inflight     -> in-flight dispatch window depth
     #   --precision    -> limb matmul precision (BENCH-SCOPED; the env
     #                     var is only honored through this entrypoint)
+    if args.tensor_policy is not None:
+        if args.tensor_policy:
+            os.environ["FABRIC_MOD_TPU_TENSOR_POLICY"] = "1"
+        else:
+            os.environ.pop("FABRIC_MOD_TPU_TENSOR_POLICY", None)
     if args.mixed_add is not None:
         os.environ["FABRIC_MOD_TPU_MIXED_ADD"] = str(args.mixed_add)
     if args.memo_cache is not None:
@@ -1379,6 +1561,27 @@ def _worker_metric(args) -> int:
                 / max(u["sustained_tx_per_sec"], 1e-9), 3),
             **extras,
         }
+        print(json.dumps(out))
+        return 0
+    if args.metric == "policyeval":
+        extras = measure_policyeval(
+            max(32, min(args.batch, 1000)), max(1, args.reps),
+            use_sw=args.policyeval_verifier == "sw")
+        rate = extras.pop("tensor_tx_per_sec")
+        out = {
+            "metric": "policyeval_validated_tx_per_sec_2of3",
+            "value": rate,
+            "unit": "tx/s",
+            "vs_baseline": round(
+                rate / extras["closure_tx_per_sec"], 3),
+            **extras,
+        }
+        if args.policyeval_verifier == "sw":
+            # host-only A/B: no device banner needed
+            print(json.dumps(out))
+            return 0
+        import jax
+        out["platform"] = jax.devices()[0].platform
         print(json.dumps(out))
         return 0
     if args.metric == "commitpipe":
@@ -1603,11 +1806,15 @@ def supervise(args, argv) -> int:
                     "--metric", args.metric]
         if getattr(args, "trace_out", None):
             cpu_argv += ["--trace-out", args.trace_out]
+        if args.tensor_policy is not None:
+            cpu_argv += ["--tensor-policy", str(args.tensor_policy)]
         if args.metric == "commitpipe":
             # keep the pipeline shape; drop to the sw backend so the
             # fallback doesn't pay a multi-minute CPU XLA compile
             cpu_argv += ["--pipeline-depth", str(args.pipeline_depth),
                          "--commitpipe-verifier", "sw"]
+        if args.metric == "policyeval":
+            cpu_argv += ["--policyeval-verifier", "sw"]
         if args.metric == "soak":
             # replayability: the fallback must run the SAME schedule
             if args.soak_seed is not None:
@@ -1640,7 +1847,8 @@ def main() -> int:
     ap.add_argument("--metric", action="append",
                     choices=("verify", "block", "e2e", "idemix", "gossip",
                              "marshal", "diffverify", "hashverify",
-                             "commitpipe", "broadcaststorm", "soak"),
+                             "commitpipe", "broadcaststorm", "soak",
+                             "policyeval"),
                     default=None,
                     help="repeatable: each metric runs in sequence and "
                          "prints its own JSON line (the smoke target "
@@ -1667,6 +1875,16 @@ def main() -> int:
                     default="device",
                     help="commitpipe: signature backend for BOTH arms "
                          "(sw = no XLA compile; the CPU smoke target)")
+    ap.add_argument("--policyeval-verifier", choices=("device", "sw"),
+                    default="device",
+                    help="policyeval: signature backend for BOTH arms "
+                         "(sw = no XLA compile; the CPU smoke target)")
+    ap.add_argument("--tensor-policy", type=int, choices=(0, 1),
+                    default=None,
+                    help="1: arm FABRIC_MOD_TPU_TENSOR_POLICY for the "
+                         "worker (commitpipe then adds the tensor-vs-"
+                         "closure differential arm); 0: force the "
+                         "closure path")
     ap.add_argument("--soak-seed", type=int, default=None,
                     help="soak: churn schedule seed (default "
                          "FMT_SOAK_SEED or 8) — a failed run prints "
@@ -1702,9 +1920,13 @@ def main() -> int:
             argv += ["--precision", args.precision]
         if args.trace_out is not None:
             argv += ["--trace-out", args.trace_out]
+        if args.tensor_policy is not None:
+            argv += ["--tensor-policy", str(args.tensor_policy)]
         if metric == "commitpipe":
             argv += ["--pipeline-depth", str(args.pipeline_depth),
                      "--commitpipe-verifier", args.commitpipe_verifier]
+        if metric == "policyeval":
+            argv += ["--policyeval-verifier", args.policyeval_verifier]
         if metric == "soak":
             if args.soak_seed is not None:
                 argv += ["--soak-seed", str(args.soak_seed)]

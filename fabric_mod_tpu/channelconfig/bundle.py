@@ -30,7 +30,7 @@ except ImportError:
     from fabric_mod_tpu.bccsp import _x509fallback as x509
 
 from fabric_mod_tpu.msp.mspimpl import Msp, MspManager, NodeOUs
-from fabric_mod_tpu.policy.cauthdsl import CompiledPolicy, PolicyError
+from fabric_mod_tpu.policy.cauthdsl import PolicyError
 from fabric_mod_tpu.policy.manager import PolicyManager
 from fabric_mod_tpu.protos import messages as m
 
@@ -238,8 +238,10 @@ class Bundle:
             if pol is None:
                 continue
             if pol.type == m.PolicyType.SIGNATURE:
-                env = m.SignaturePolicyEnvelope.decode(pol.value)
-                mgr.add_policy(pname, CompiledPolicy(env, self.msp_manager))
+                from fabric_mod_tpu.policy.manager import (
+                    compile_policy_bytes)
+                mgr.add_policy(pname, compile_policy_bytes(
+                    pol.value, self.msp_manager, self.sequence))
             elif pol.type == m.PolicyType.IMPLICIT_META:
                 metas.append((pname, m.ImplicitMetaPolicy.decode(pol.value)))
             else:

@@ -276,7 +276,7 @@ def run_pipeline(n_txs: int, verifier, reps_unused: int = 1,
                     # FMT_TRACE sub-span split of the buckets above:
                     # which part of stage/await/commit actually burns
                     # the wall (recv/unpack/der_marshal/device_
-                    # dispatch/verdict_await/policy_eval/mvcc/
+                    # dispatch/verdict_await/policy_*/mvcc/
                     # ledger_write) — the data the next kernel is
                     # chosen by
                     stats["stage_attribution"] = {

@@ -227,9 +227,11 @@ class GossipNode:
         check over the peer's identity — sufficient for membership
         (no signature to check at dissemination time; the reference's
         AccessFilter does the same principal-only evaluation)."""
-        from fabric_mod_tpu.policy.cauthdsl import CompiledPolicy
-        msp_mgr = self._channel.bundle().msp_manager
-        pol = CompiledPolicy(member_orgs_policy, msp_mgr)
+        from fabric_mod_tpu.policy.manager import compile_policy_bytes
+        bundle = self._channel.bundle()
+        msp_mgr = bundle.msp_manager
+        pol = compile_policy_bytes(member_orgs_policy.encode(), msp_mgr,
+                                   bundle.sequence)
 
         def eligible(identity_bytes: bytes) -> bool:
             try:

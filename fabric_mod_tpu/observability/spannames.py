@@ -25,7 +25,9 @@ DECLARED_SPANS: Set[str] = {
     "gossip.drain",
     "ledger_write",
     "mvcc",
-    "policy_eval",
+    "policy_device",
+    "policy_finish",
+    "policy_gather",
     "recv",
     "unpack",
     "verdict_await",

@@ -28,7 +28,8 @@ and NO span objects are allocated):
   ``start_timeline(consumer, block_num)`` per block; every span that
   finishes while that timeline is installed (``timeline_scope``)
   becomes one of its sub-stage entries (recv, unpack, der_marshal,
-  device_dispatch, verdict_await, policy_eval, mvcc, ledger_write,
+  device_dispatch, verdict_await, policy_gather, policy_device,
+  policy_finish, mvcc, ledger_write,
   fingerprint).  The timeline object itself is the cross-thread
   carrier: the commitpipe stage loop starts it, StagedBlock carries
   it, the commit loop resumes it — one per-block record of where the
@@ -113,7 +114,7 @@ _SUBSTAGE_OPTS = MetricOpts(
     "fabric", "trace", "substage_seconds",
     help="Per-span wall seconds by sub-stage name (the commit "
          "timeline's recv/unpack/der_marshal/device_dispatch/"
-         "verdict_await/policy_eval/mvcc/ledger_write/fingerprint "
+         "verdict_await/policy_*/mvcc/ledger_write/fingerprint "
          "split, FMT_TRACE armed only).",
     label_names=("stage",))
 _COMPILES_OPTS = MetricOpts(

@@ -31,10 +31,14 @@ import functools
 
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from fabric_mod_tpu.observability import tracing
 from fabric_mod_tpu.observability.metrics import (MetricOpts,
                                                   default_provider)
 from fabric_mod_tpu.policy import ApplicationPolicyEvaluator, BatchCollector
+from fabric_mod_tpu.policy import tensorpolicy
+from fabric_mod_tpu.protos import batchdecode
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
 from fabric_mod_tpu.protos.protoutil import SignedData
@@ -139,18 +143,25 @@ class StagedBlock:
     `trace_timeline` (FMT_TRACE armed only, else None) is the block's
     flight-recorder timeline riding the stage→commit handoff: the
     engine that staged this block attaches it, the committing side
-    resumes it — context propagation by carrying the context."""
+    resumes it — context propagation by carrying the context.
+
+    `session` (FABRIC_MOD_TPU_TENSOR_POLICY armed only, else None) is
+    the block's tensor-policy session: resolve_mask hands it the
+    verify mask BEFORE the host sync, so a device-resident mask flows
+    straight into the jitted policy program (fused downstream of the
+    batch verify) while the host copy is still materializing."""
 
     __slots__ = ("block", "validator", "works", "mask_fn", "_mask",
-                 "trace_timeline")
+                 "trace_timeline", "session")
 
-    def __init__(self, block, validator, works, mask_fn):
+    def __init__(self, block, validator, works, mask_fn, session=None):
         self.block = block
         self.validator = validator
         self.works = works
         self.mask_fn = mask_fn
         self._mask = None
         self.trace_timeline = None
+        self.session = session
 
     def resolve_mask(self):
         """Await the device verdicts (idempotent).  The commit
@@ -162,7 +173,17 @@ class StagedBlock:
             # sub-stage is attributed HERE so neither path can hide it
             with tracing.span("verdict_await",
                               block=self.block.header.number):
-                self._mask = self.mask_fn()
+                raw = self.mask_fn()
+                if self.session is not None:
+                    # bind (and, on a device mask, dispatch) the
+                    # whole-block policy program before the host sync
+                    self.session.attach_mask(raw)
+                self._mask = np.asarray(raw, bool)
+                # the fused verify seam defers its verdict-cache
+                # write-back to the consumer's sync point — this is it
+                writeback = getattr(self.mask_fn, "writeback", None)
+                if writeback is not None:
+                    writeback()
         return self._mask
 
     @property
@@ -192,12 +213,17 @@ class TxValidator:
                  config_apply: Optional[Callable[[m.Envelope], None]] = None,
                  state_metadata: Optional[Callable[[str, str],
                                                    Optional[bytes]]] = None,
-                 plugin_registry=None):
+                 plugin_registry=None,
+                 config_sequence: int = 0):
         self.channel_id = channel_id
         self._msp_mgr = msp_mgr
         self._policy_eval = policy_eval
         self._verifier = verifier
         self._vinfo = vinfo
+        # keys the tensor-policy principal memo: a validator is built
+        # per bundle, and the sequence makes sure a config update can
+        # never be answered from a previous epoch's principal matrix
+        self._config_seq = config_sequence
         # named validation plugins (reference: handlers/library
         # registry.go:79); definitions naming an unknown plugin fail
         # closed in _stage_tx
@@ -218,21 +244,29 @@ class TxValidator:
 
     # -- pass 1: host unpack + staging -----------------------------------
     def _stage_tx(self, env: m.Envelope, work: _TxWork,
-                  collector: BatchCollector, inblock_vp) -> None:
+                  collector: BatchCollector, inblock_vp,
+                  session=None, spine=None) -> None:
         """Syntactic validation + creator/endorsement staging for one
         tx.  Sets work.flag on terminal failure, else leaves VALID
-        pending the device verdicts.
+        pending the device verdicts.  `spine` (protos/batchdecode) is
+        the batch pre-pass's already-decoded envelope/payload/header
+        layers — value-identical to the generic decode below, which
+        stays as the per-tx fallback for rows the scanner rejected.
         (reference: msgvalidation.go:248 ValidateTransaction)"""
         if not env.payload:
             work.flag = V.NIL_ENVELOPE
             return
-        try:
-            payload = protoutil.unmarshal_envelope_payload(env)
-            ch = m.ChannelHeader.decode(payload.header.channel_header)
-            sh = m.SignatureHeader.decode(payload.header.signature_header)
-        except Exception:
-            work.flag = V.BAD_PAYLOAD
-            return
+        if spine is not None:
+            payload, ch, sh = spine.payload, spine.ch, spine.sh
+        else:
+            try:
+                payload = protoutil.unmarshal_envelope_payload(env)
+                ch = m.ChannelHeader.decode(payload.header.channel_header)
+                sh = m.SignatureHeader.decode(
+                    payload.header.signature_header)
+            except Exception:
+                work.flag = V.BAD_PAYLOAD
+                return
         if not ch.channel_id or ch.channel_id != self.channel_id:
             work.flag = V.BAD_CHANNEL_HEADER
             return
@@ -290,7 +324,14 @@ class TxValidator:
                     return
                 ns = (cca.chaincode_id.name
                       if cca.chaincode_id is not None else "")
-                plugin_name, policy_bytes = self._resolve_vinfo(ns, cca)
+                # ONE rwset decode per action, shared by validation-
+                # info resolution and key-level policy staging (these
+                # used to each decode cca.results themselves)
+                try:
+                    rwset = m.TxReadWriteSet.decode(cca.results)
+                except Exception:
+                    rwset = None
+                plugin_name, policy_bytes = self._resolve_vinfo(ns, rwset)
                 evaluator = self._plugins.resolve(plugin_name,
                                                   self._policy_eval)
                 if evaluator is None:
@@ -303,44 +344,55 @@ class TxValidator:
                                   identity=e.endorser,
                                   signature=e.signature)
                        for e in endorsements]
-                cc_pending = evaluator.prepare(
-                    policy_bytes, sds, collector)
+                # session rides only through evaluators that opt in;
+                # plugin evaluators keep their 3-arg prepare contract
+                if session is not None and getattr(
+                        evaluator, "supports_tensor_session", False):
+                    cc_pending = evaluator.prepare(
+                        policy_bytes, sds, collector, session)
+                else:
+                    cc_pending = evaluator.prepare(
+                        policy_bytes, sds, collector)
                 key_evals = self._stage_key_policies(
-                    cca, sds, collector, inblock_vp, work)
+                    rwset, sds, collector, inblock_vp, work, session)
                 work.actions.append(_ActionEval(cc_pending, key_evals))
         except Exception:
             work.flag = V.INVALID_ENDORSER_TRANSACTION
             return
 
-    def _resolve_vinfo(self, ns: str, cca):
+    def _resolve_vinfo(self, ns: str, rwset):
         """Validation info for one action; `_lifecycle` writes are
         resolved write-aware when the provider supports it (org-local
         approval txs validate against that org's Endorsement policy —
-        see peer/lifecycle.py)."""
+        see peer/lifecycle.py).  `rwset` is the action's decoded
+        TxReadWriteSet (None when cca.results was malformed — fall
+        back to tx-level resolution; decode errors are surfaced by
+        validation itself)."""
         from fabric_mod_tpu.peer.lifecycle import LIFECYCLE_NS
         write_aware = getattr(self._vinfo, "validation_info_for_writes",
                               None)
-        if write_aware is not None and ns == LIFECYCLE_NS:
+        if write_aware is not None and ns == LIFECYCLE_NS and \
+                rwset is not None:
             try:
-                rwset = m.TxReadWriteSet.decode(cca.results)
                 keys = [w.key
                         for nsrw in rwset.ns_rwset
                         if nsrw.namespace == ns
                         for w in m.KVRWSet.decode(nsrw.rwset).writes]
                 return write_aware(ns, keys)
-            except Exception:  # fmtlint: allow[swallowed-exceptions] -- malformed rwset: fall back to tx-level VP resolution; decode errors are surfaced by validation itself
+            except Exception:  # fmtlint: allow[swallowed-exceptions] -- malformed inner rwset: fall back to tx-level VP resolution; decode errors are surfaced by validation itself
                 pass
         return self._vinfo.validation_info(ns)
 
-    def _stage_key_policies(self, cca, sds, collector, inblock_vp, work):
+    def _stage_key_policies(self, rwset, sds, collector, inblock_vp,
+                            work, session=None):
         """Stage every candidate key-level endorsement policy of this
         action's written keys (reference: validator_keylevel.go — the
         committed VALIDATION_PARAMETER plus any same-block overrides
-        whose applicability pass 3 resolves in order)."""
+        whose applicability pass 3 resolves in order).  `rwset` is the
+        action's already-decoded TxReadWriteSet (None = malformed ->
+        no key evals, the historical behavior)."""
         key_evals = []
-        try:
-            rwset = m.TxReadWriteSet.decode(cca.results)
-        except Exception:
+        if rwset is None:
             return key_evals
         from fabric_mod_tpu.ledger.rwsetutil import parse_tx_rwset
         for ns, kv in parse_tx_rwset(rwset):
@@ -355,10 +407,10 @@ class TxValidator:
                     vp = self._state_metadata(ns, key)
                     if vp:
                         committed_pending = self._policy_eval.prepare(
-                            vp, sds, collector)
+                            vp, sds, collector, session)
                 cands = inblock_vp.get((ns, key), ())
-                inblock = [(idx, self._policy_eval.prepare(vp, sds,
-                                                           collector))
+                inblock = [(idx, self._policy_eval.prepare(
+                    vp, sds, collector, session))
                            for idx, vp in cands]
                 # EVERY written key gets an eval entry: keys without an
                 # effective VP resolve to None in pass 3 and force the
@@ -386,23 +438,45 @@ class TxValidator:
         reads (see StagedBlock.needs_barrier)."""
         works: List[_TxWork] = []
         collector = BatchCollector()
+        session = None
+        if tensorpolicy.enabled():
+            session = tensorpolicy.TensorSession(self._msp_mgr,
+                                                 self._config_seq)
         # (ns, key) -> [(tx_idx, ApplicationPolicy bytes)]: the
         # VALIDATION_PARAMETER writes of EARLIER txs in this block —
         # the intra-block dependency structure of validator_keylevel.go
         inblock_vp: Dict[tuple, list] = {}
         with tracing.span("unpack", block=block.header.number,
                           txs=len(block.data.data)):
+            # batch pre-pass: the whole block's envelope/payload/
+            # header spine in one vectorized scan; rows the scanner
+            # could not prove clean come back None and take the
+            # generic per-tx decode below (identical outcomes)
+            spines = batchdecode.decode_block_spine(block.data.data)
             for idx, data in enumerate(block.data.data):
                 work = _TxWork()
                 works.append(work)
-                try:
-                    env = m.Envelope.decode(data)
-                except Exception:
-                    work.flag = V.BAD_PAYLOAD
-                    continue
-                self._stage_tx(env, work, collector, inblock_vp)
+                spine = spines[idx]
+                if spine is not None:
+                    env = spine.env
+                else:
+                    try:
+                        env = m.Envelope.decode(data)
+                    except Exception:
+                        work.flag = V.BAD_PAYLOAD
+                        continue
+                self._stage_tx(env, work, collector, inblock_vp,
+                               session, spine)
                 for ns, key, vp in work.vp_writes:
                     inblock_vp.setdefault((ns, key), []).append((idx, vp))
+        if session is not None and len(session):
+            # build the block's dense policy tensors (the MSP
+            # principal matrix lands here, memoized per pair)
+            with tracing.span("policy_gather",
+                              block=block.header.number,
+                              instances=len(session),
+                              fallbacks=session.fallbacks):
+                session.finalize()
 
         # pass 2: dispatch the device batch (async when the verifier
         # supports it; the resolver blocks only when called).  Repeats
@@ -423,13 +497,22 @@ class TxValidator:
         with tracing.span("device_dispatch",
                           block=block.header.number,
                           items=len(collector.items)):
-            async_fn = getattr(self._verifier, "verify_many_async", None)
+            # with a tensor session, prefer the verifier's FUSED seam:
+            # its resolver may hand back a device-resident mask the
+            # policy program consumes without a host round trip
+            async_fn = None
+            if session is not None:
+                async_fn = getattr(self._verifier,
+                                   "verify_many_fused_async", None)
+            if async_fn is None:
+                async_fn = getattr(self._verifier, "verify_many_async",
+                                   None)
             if async_fn is not None:
                 mask_fn = async_fn(collector.items)
             else:
                 items = collector.items
                 mask_fn = lambda: self._verifier.verify_many(items)
-        return StagedBlock(block, self, works, mask_fn)
+        return StagedBlock(block, self, works, mask_fn, session)
 
     def finish(self, staged: "StagedBlock") -> List[int]:
         """Pass 3: await the device verdicts, then sequential flag
@@ -438,10 +521,20 @@ class TxValidator:
         effects of earlier VALID ones."""
         block, works = staged.block, staged.works
         mask = staged.resolve_mask()
+        session = staged.session
+        if session is not None and len(session):
+            # ONE evaluator pass produces every chaincode-level and
+            # key-level verdict of the block (jitted program on a
+            # device mask, vectorized numpy on a host mask); the
+            # host loop below then reads precomputed booleans
+            with tracing.span("policy_device",
+                              block=block.header.number,
+                              instances=len(session)):
+                session.verdicts()
         flags: List[int] = []
         seen_txids = set()
         applied_vp: Dict[tuple, int] = {}   # (ns, key) -> writer tx_idx
-        with tracing.span("policy_eval", block=block.header.number):
+        with tracing.span("policy_finish", block=block.header.number):
             for idx, work in enumerate(works):
                 flag = self._finish_tx(work, mask, applied_vp)
                 if flag == V.VALID and work.txid:

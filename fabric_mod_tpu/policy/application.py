@@ -17,26 +17,42 @@ from fabric_mod_tpu.protos.protoutil import SignedData
 
 
 class ApplicationPolicyEvaluator:
-    def __init__(self, msp_mgr, channel_policy_manager: Optional[PolicyManager] = None):
+    # the validator passes its tensor session only to evaluators that
+    # declare this — third-party validation plugins keep the 3-arg
+    # prepare(policy, sds, collector) contract untouched
+    supports_tensor_session = True
+
+    def __init__(self, msp_mgr,
+                 channel_policy_manager: Optional[PolicyManager] = None,
+                 sequence: int = 0):
+        """`sequence` is the owning bundle's config sequence: it keys
+        the shared compiled-policy memo (policy/manager.py), so a
+        config update can never be answered from a stale compile."""
         self._msp_mgr = msp_mgr
         self._channel_mgr = channel_policy_manager
+        self._sequence = sequence
         self._compiled_cache: dict = {}
 
     def _resolve(self, policy_bytes: bytes):
         """ApplicationPolicy bytes -> two-phase policy object.
 
         Inline signature policies are compile-cached by their bytes
-        (immutable); channel references are re-resolved on every call
-        like the reference (core/policy/application.go Evaluate) so a
-        config update that replaces the named policy takes effect
-        immediately.
+        (immutable) on this instance, backed by the shared
+        (bytes, config sequence)-keyed memo in policy/manager.py so a
+        rebuilt evaluator (new validator, bench world, gossip path)
+        reuses compiles instead of re-decoding; channel references are
+        re-resolved on every call like the reference
+        (core/policy/application.go Evaluate) so a config update that
+        replaces the named policy takes effect immediately.
         """
         cached = self._compiled_cache.get(policy_bytes)
         if cached is not None:
             return cached
         ap = m.ApplicationPolicy.decode(policy_bytes)
         if ap.signature_policy is not None:
-            pol = CompiledPolicy(ap.signature_policy, self._msp_mgr)
+            from fabric_mod_tpu.policy.manager import compile_policy_bytes
+            pol = compile_policy_bytes(ap.signature_policy.encode(),
+                                       self._msp_mgr, self._sequence)
             self._compiled_cache[policy_bytes] = pol
             return pol
         if ap.channel_config_policy_reference:
@@ -53,8 +69,9 @@ class ApplicationPolicyEvaluator:
 
     def prepare(self, policy_bytes: bytes,
                 signed_datas: Sequence[SignedData],
-                collector: BatchCollector):
-        return self._resolve(policy_bytes).prepare(signed_datas, collector)
+                collector: BatchCollector, session=None):
+        return self._resolve(policy_bytes).prepare(
+            signed_datas, collector, session)
 
     def evaluate(self, policy_bytes: bytes,
                  signed_datas: Sequence[SignedData],

@@ -58,9 +58,12 @@ class ImplicitMetaPolicyObj:
             self.threshold = 1
 
     def prepare(self, signed_datas: Sequence[SignedData],
-                collector: BatchCollector):
+                collector: BatchCollector, session=None):
+        # the meta threshold itself is a trivial host sum; the
+        # sub-policies each ride the tensor session when they can
         return _MetaPending(
-            [s.prepare(signed_datas, collector) for s in self._subs],
+            [s.prepare(signed_datas, collector, session)
+             for s in self._subs],
             self.threshold)
 
     def evaluate_signed_data(self, signed_datas: Sequence[SignedData],
@@ -139,6 +142,48 @@ def policy_from_proto(pol: m.Policy, msp_mgr) -> object:
     policies only here; implicit meta needs the tree context — use
     PolicyManager.resolve_implicit_meta)."""
     if pol.type == m.PolicyType.SIGNATURE:
-        env = m.SignaturePolicyEnvelope.decode(pol.value)
-        return CompiledPolicy(env, msp_mgr)
+        return compile_policy_bytes(pol.value, msp_mgr)
     raise PolicyError(f"unsupported policy type {pol.type}")
+
+
+# ---------------------------------------------------------------------------
+# Compiled-policy memo: one CompiledPolicy per (envelope bytes, config
+# sequence) per MSP manager.  Before this memo every evaluation SITE
+# (each ApplicationPolicyEvaluator instance, each bundle build, each
+# gossip eligibility check) re-decoded the envelope and re-compiled
+# the closure tree for bytes it had already seen; the memo makes the
+# compile a dict hit.  Weak-keyed by the manager so a bundle swap
+# (new MspManager) can never serve policies bound to dead trust
+# roots, and the sequence key guards any manager mutated in place.
+# ---------------------------------------------------------------------------
+
+import threading as _threading
+import weakref as _weakref
+
+_COMPILE_MEMO: "_weakref.WeakKeyDictionary" = _weakref.WeakKeyDictionary()
+_COMPILE_LOCK = _threading.Lock()  # fmtlint: allow[locks] -- leaf lock guarding a memo dict get-or-create, never nested
+_COMPILE_MEMO_CAP = 4096
+
+
+def compile_policy_bytes(policy_bytes: bytes, msp_mgr,
+                         sequence: int = 0) -> CompiledPolicy:
+    """SignaturePolicyEnvelope bytes -> CompiledPolicy, memoized."""
+    key = (bytes(policy_bytes), sequence)
+    with _COMPILE_LOCK:
+        per = _COMPILE_MEMO.get(msp_mgr)
+        if per is None:
+            per = {}
+            _COMPILE_MEMO[msp_mgr] = per
+        got = per.get(key)
+    if got is not None:
+        return got
+    env = m.SignaturePolicyEnvelope.decode(policy_bytes)
+    pol = CompiledPolicy(env, msp_mgr)
+    with _COMPILE_LOCK:
+        if len(per) >= _COMPILE_MEMO_CAP:
+            # the live set (a channel's distinct policies) is tiny
+            # next to the bound; overflow means sequence churn, and
+            # stale epochs never hit again — reset beats LRU here
+            per.clear()
+        per[key] = pol
+    return pol

@@ -1,0 +1,599 @@
+"""Whole-block tensor policy evaluation: SignaturePolicy trees as
+dense mask/threshold tensors, every verdict in one program.
+
+PR 9's first trace attribution measured the commit bucket at 87%
+``policy_eval`` — the per-tx host loop that walks compiled-policy
+closures one ``PendingEval.finish`` at a time, re-running
+``msp.satisfies_principal`` (a full cert-chain validation) for every
+(identity, principal) visit of every evaluation.  The reference
+already evaluates in the batch-friendly shape (``cauthdsl.compile``
+verifies all signatures first, then runs the combinatorial walk); this
+module finishes the job by compiling the walk itself into data:
+
+* ``TensorProgram`` — a ``SignaturePolicyEnvelope`` rule tree
+  flattened into a fixed op list (LEAF / ENTER / SAVE / COMMIT /
+  THRESH) whose execution reproduces the closure compiler's greedy
+  used-flag semantics EXACTLY: a leaf consumes the first unused
+  satisfying identity, an NOutOf child runs against a trial copy of
+  the used flags and commits only on success, children never early
+  exit.  Trees that exceed the fixed caps (depth, ops, identities,
+  principals) are non-tensorizable and fall back to the closure path,
+  counted on /metrics.
+* ``PrincipalMemo`` — the host-side principal-satisfaction matrix is
+  computed via the MSP exactly once per (identity, principal) pair,
+  keyed by certificate fingerprint + principal bytes + the channel's
+  CONFIG SEQUENCE (a config update that changes membership must never
+  be answered from a stale matrix).  One memo per MspManager
+  (weak-keyed), so a bundle swap naturally starts cold.
+* ``TensorSession`` — per-block: every policy evaluation staged by
+  the validator lands as one row of the session's dense tensors
+  (satisfaction matrix, verdict-mask gather indices, flattened op
+  program), and ALL verdicts — chaincode-level and key-level — are
+  produced by ONE evaluator pass fused downstream of the block's
+  ``p256.batch_verify_raw`` mask.  When the mask arrives as a jax
+  device array the jitted program is dispatched against it directly
+  (no device->host->device round trip); a host (numpy) mask runs the
+  same op semantics through the vectorized numpy interpreter — no XLA
+  compile on the sw arm, bit-identical verdicts (differential-tested
+  against each other and against the closures).
+
+Gated by ``FABRIC_MOD_TPU_TENSOR_POLICY``; unset, the validator stays
+on the closure path byte-for-byte.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import weakref
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fabric_mod_tpu.observability.metrics import (MetricOpts,
+                                                  default_provider)
+from fabric_mod_tpu.utils import knobs as _knobs
+
+# ---------------------------------------------------------------------------
+# Tensorizability caps: fixed so the jitted program compiles for a
+# handful of padded shapes, ever (the BUCKETS discipline of
+# bccsp/tpu.py).  Anything larger falls back to the closure path.
+# ---------------------------------------------------------------------------
+MAX_IDENTS = 8          # identity slots per evaluation instance
+MAX_PRINCIPALS = 8      # principals per policy envelope
+MAX_DEPTH = 4           # NOutOf nesting depth (SAVE trial frames)
+MAX_OPS = 64            # flattened program length
+# stack slots: NOutOf nodes legally sit at depths 0..MAX_DEPTH and
+# each pushes a COUNTER, so the counter stack needs MAX_DEPTH+1
+# slots; SAVE frames max out at MAX_DEPTH (the root has none) but
+# share the sizing for one mask range
+STACK_SLOTS = MAX_DEPTH + 1
+
+# opcodes of the flattened program
+OP_NOP = 0              # padding
+OP_LEAF = 1             # arg = principal column: greedy first-unused pick
+OP_ENTER = 2            # push a zero child-success counter
+OP_SAVE = 3             # push a trial copy of the used flags
+OP_COMMIT = 4           # pop trial: keep on child success, else restore
+OP_THRESH = 5           # arg = n: result = (popped counter >= n)
+# LEAF CHILD fused with its trial/commit: SAVE/LEAF/COMMIT around a
+# bare leaf is semantically the leaf alone (a failed leaf consumes
+# nothing, so the restore is a no-op; a successful leaf's consumption
+# is always committed) plus the parent counter increment — one op
+# instead of three, and most real programs (NOutOf over SignedBy) are
+# nothing but these
+OP_LEAFC = 6            # arg = principal column; counter += success
+
+
+def enabled() -> bool:
+    """The FABRIC_MOD_TPU_TENSOR_POLICY gate."""
+    return _knobs.get_bool("FABRIC_MOD_TPU_TENSOR_POLICY")
+
+
+_FALLBACK_OPTS = MetricOpts(
+    "fabric", "policy", "tensor_fallback_total",
+    help="Policy evaluations that fell back to the closure path "
+         "(non-tensorizable tree shape, or more identities than the "
+         "tensor caps).")
+_INSTANCES_OPTS = MetricOpts(
+    "fabric", "policy", "tensor_instances_total",
+    help="Policy evaluations answered by the whole-block tensor "
+         "program.")
+_MEMO_HITS_OPTS = MetricOpts(
+    "fabric", "policy", "principal_memo_hits",
+    help="Principal-satisfaction lookups answered by the "
+         "config-sequence-keyed memo (MSP cert-chain walk skipped).")
+_MEMO_MISSES_OPTS = MetricOpts(
+    "fabric", "policy", "principal_memo_misses",
+    help="Principal-satisfaction pairs computed via the MSP.")
+
+
+@functools.lru_cache(maxsize=None)
+def _metrics():
+    prov = default_provider()
+    return (prov.counter(_FALLBACK_OPTS), prov.counter(_INSTANCES_OPTS),
+            prov.counter(_MEMO_HITS_OPTS), prov.counter(_MEMO_MISSES_OPTS))
+
+
+# ---------------------------------------------------------------------------
+# Compilation: rule tree -> flat op program
+# ---------------------------------------------------------------------------
+
+class TensorProgram:
+    """One SignaturePolicyEnvelope compiled to the flat op form.
+    Immutable; shared by every evaluation instance of the policy."""
+
+    __slots__ = ("ops", "args", "n_ops", "depth", "principals",
+                 "principal_bytes")
+
+    def __init__(self, ops: List[int], args: List[int], depth: int,
+                 principals: Sequence):
+        self.n_ops = len(ops)
+        self.ops = np.asarray(ops, np.int32)
+        self.args = np.asarray(args, np.int32)
+        self.depth = depth
+        self.principals = list(principals)
+        self.principal_bytes = [p.encode() for p in self.principals]
+
+
+def compile_tensor_program(envelope) -> Optional[TensorProgram]:
+    """SignaturePolicyEnvelope -> TensorProgram, or None when the tree
+    is non-tensorizable (over the caps, or malformed — malformed trees
+    must keep failing through the closure compiler's own errors)."""
+    rule = envelope.rule
+    principals = envelope.identities
+    if rule is None or len(principals) > MAX_PRINCIPALS:
+        return None
+    ops: List[int] = []
+    args: List[int] = []
+    depth = [0]
+
+    def emit(node, d: int) -> bool:
+        if d > MAX_DEPTH:
+            return False
+        depth[0] = max(depth[0], d)
+        if node.n_out_of is not None:
+            ops.append(OP_ENTER)
+            args.append(0)
+            for child in node.n_out_of.rules:
+                if child.n_out_of is None:
+                    idx = child.signed_by
+                    if not 0 <= idx < len(principals):
+                        return False  # the closure compiler raises here
+                    ops.append(OP_LEAFC)
+                    args.append(idx)
+                    if len(ops) > MAX_OPS:
+                        return False
+                    continue
+                ops.append(OP_SAVE)
+                args.append(0)
+                if not emit(child, d + 1):
+                    return False
+                ops.append(OP_COMMIT)
+                args.append(0)
+            n = int(node.n_out_of.n)
+            if not -(1 << 31) <= n < (1 << 31):
+                # outside the int32 args plane: fall back rather than
+                # overflow (the closure path evaluates `verified >= n`
+                # for any n, so the verdict must come from there)
+                return False
+            ops.append(OP_THRESH)
+            args.append(n)
+            return len(ops) <= MAX_OPS
+        idx = node.signed_by
+        if not 0 <= idx < len(principals):
+            return False              # the closure compiler raises here
+        ops.append(OP_LEAF)
+        args.append(idx)
+        return len(ops) <= MAX_OPS
+
+    if not emit(rule, 0):
+        return None
+    return TensorProgram(ops, args, max(1, depth[0]), principals)
+
+
+# ---------------------------------------------------------------------------
+# Principal-satisfaction memo
+# ---------------------------------------------------------------------------
+
+class PrincipalMemo:
+    """Bounded memo of msp.satisfies_principal verdicts keyed by
+    (mspid, cert fingerprint, principal bytes, config sequence).
+
+    satisfies_principal re-walks the identity's cert chain on every
+    call — the closure path paid that per (identity, principal) visit
+    per evaluation; the tensor path pays it once per unique pair per
+    config epoch.  The config-sequence key makes a config update (new
+    CRLs, changed NodeOUs) a clean miss even if a caller keeps one
+    memo across bundles.
+    """
+
+    def __init__(self, capacity: int = 65536):
+        self.capacity = capacity
+        self._d: dict = {}
+        # leaf lock, never nested (same stance as VerdictCache)
+        self._lock = threading.Lock()  # fmtlint: allow[locks] -- leaf lock on the per-pair memo path, never nested; C-level speed matters
+
+    def usable(self, ident) -> bool:
+        """Can this identity be memo-keyed?  The key is the x509 cert
+        fingerprint; cert-less identities (idemix pseudonyms — exactly
+        the non-P256 host-verdict lanes) cannot ride the tensors and
+        their evaluations fall back to the closure path."""
+        return getattr(ident, "cert", None) is not None
+
+    def satisfied(self, msp_mgr, ident, principal,
+                  principal_bytes: bytes, seq: int) -> bool:
+        # cert fingerprint cached on the identity object: the CachedMsp
+        # deserialize cache hands back the SAME Identity for repeated
+        # creator/endorser bytes, so this hash is paid once per cert,
+        # not once per (pair, block) probe
+        fp = getattr(ident, "_fmt_cert_fp", None)
+        if fp is None:
+            from fabric_mod_tpu.msp.identities import cert_fingerprint
+            fp = cert_fingerprint(ident.cert)
+            try:
+                ident._fmt_cert_fp = fp
+            except Exception:  # fmtlint: allow[swallowed-exceptions] -- slotted/frozen identity: skip the attr cache, correctness unchanged
+                pass
+        key = (ident.mspid, fp, principal_bytes, seq)
+        with self._lock:
+            got = self._d.get(key)
+        _fb, _inst, hits, misses = _metrics()
+        if got is not None:
+            hits.add(1)
+            return got
+        misses.add(1)
+        val = bool(msp_mgr.satisfies_principal(ident, principal))
+        with self._lock:
+            if len(self._d) >= self.capacity:
+                # wholesale reset beats LRU bookkeeping here: the live
+                # working set (a channel's identities x principals) is
+                # tiny next to the bound, so an overflow means key
+                # churn (config sequences advancing) — old epochs
+                # never hit again anyway
+                self._d.clear()
+            self._d[key] = val
+        return val
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+
+_MEMO_BY_MGR: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_MEMO_LOCK = threading.Lock()  # fmtlint: allow[locks] -- leaf lock guarding a weak dict get-or-create, never nested
+
+
+def principal_memo_for(msp_mgr) -> PrincipalMemo:
+    """One memo per MspManager (weak-keyed): managers are immutable
+    per bundle, so verdicts never cross trust-root boundaries, and a
+    bundle swap (new manager) starts a fresh memo."""
+    with _MEMO_LOCK:
+        memo = _MEMO_BY_MGR.get(msp_mgr)
+        if memo is None:
+            memo = PrincipalMemo()
+            _MEMO_BY_MGR[msp_mgr] = memo
+        return memo
+
+
+# ---------------------------------------------------------------------------
+# The evaluator: one op-step semantics, two drivers (numpy / jax)
+# ---------------------------------------------------------------------------
+
+def _step(xp, state, opc, arg, sat_col, valid):
+    """Execute op t for every instance at once.  Ops are exclusive per
+    instance, so the per-op updates compose with where-masks; the
+    semantics mirror cauthdsl._compile exactly:
+
+      LEAF    first unused valid identity satisfying the principal is
+              consumed (argmax = the closure's in-order scan)
+      SAVE    trial = list(used) before a child runs
+      COMMIT  child failed -> used[:] = trial restored; succeeded ->
+              keep mutations, count += 1 (no early exit either way)
+      THRESH  verified >= n
+    """
+    used, ustack, usp, cstack, csp, result = state
+    n_i = used.shape[1]
+    is_leafc = opc == OP_LEAFC
+    is_leaf = (opc == OP_LEAF) | is_leafc
+    is_enter = opc == OP_ENTER
+    is_save = opc == OP_SAVE
+    is_commit = opc == OP_COMMIT
+    is_thresh = opc == OP_THRESH
+    one = xp.int32(1) if hasattr(xp, "int32") else 1
+    # mask range sized from the stacks themselves: the counter stack
+    # must hold one more level than the SAVE frames (see STACK_SLOTS)
+    depth = ustack.shape[1]
+
+    # LEAF / LEAFC: greedy first-unused pick
+    avail = valid & ~used & sat_col
+    found = avail.any(axis=1)
+    first = xp.argmax(avail, axis=1)
+    pick = ((xp.arange(n_i)[None, :] == first[:, None])
+            & found[:, None] & is_leaf[:, None])
+    used = used | pick
+    result = xp.where(is_leaf, found, result)
+
+    drange = xp.arange(depth)
+    # SAVE: push the trial copy at usp
+    push = (drange[None, :] == usp[:, None]) & is_save[:, None]
+    ustack = xp.where(push[:, :, None], used[:, None, :], ustack)
+    usp = usp + is_save.astype(usp.dtype)
+
+    # COMMIT: pop the trial; restore on child failure; count a success
+    top = drange[None, :] == (usp - one)[:, None]
+    saved = (ustack & top[:, :, None]).any(axis=1)
+    restore = is_commit[:, None] & ~result[:, None]
+    used = xp.where(restore, saved, used)
+    usp = usp - is_commit.astype(usp.dtype)
+    ctop = drange[None, :] == (csp - one)[:, None]
+    # counter increments: a COMMIT whose child succeeded, or a fused
+    # leaf child (LEAFC) that found an identity this step
+    counted = (is_commit & result) | (is_leafc & found)
+    cstack = cstack + xp.where(
+        ctop & counted[:, None], 1, 0).astype(cstack.dtype)
+
+    # ENTER: push a zero counter at csp
+    cpush = (drange[None, :] == csp[:, None]) & is_enter[:, None]
+    cstack = xp.where(cpush, xp.zeros((), cstack.dtype), cstack)
+    csp = csp + is_enter.astype(csp.dtype)
+
+    # THRESH: verified >= n, pop the counter (ctop is this op's own
+    # counter: thresh instances took no enter/commit branch this step)
+    count_top = xp.where(ctop, cstack, 0).sum(axis=1)
+    result = xp.where(is_thresh, count_top >= arg, result)
+    csp = csp - is_thresh.astype(csp.dtype)
+    return used, ustack, usp, cstack, csp, result
+
+
+def eval_numpy(valid: np.ndarray, sat: np.ndarray, ops: np.ndarray,
+               args: np.ndarray, depth: int = STACK_SLOTS) -> np.ndarray:
+    """Vectorized host interpreter: (N, I) valid, (N, I, P) sat,
+    (N, T) ops/args -> (N,) verdicts.  Tight shapes, no compile — the
+    sw/CPU arm's evaluator."""
+    n, n_i = valid.shape
+    t_ops = ops.shape[1]
+    state = (np.zeros((n, n_i), bool),
+             np.zeros((n, depth, n_i), bool),
+             np.zeros(n, np.int32),
+             np.zeros((n, depth), np.int32),
+             np.zeros(n, np.int32),
+             np.zeros(n, bool))
+    n_p = sat.shape[2]
+    for t in range(t_ops):
+        a = args[:, t]
+        sat_col = np.take_along_axis(
+            sat, np.clip(a, 0, n_p - 1)[:, None, None], axis=2)[:, :, 0]
+        state = _step(np, state, ops[:, t], a, sat_col, valid)
+    return state[-1]
+
+
+@functools.lru_cache(maxsize=None)
+def _jax_eval_fn():
+    """The jitted whole-block evaluator (cached once).  Shapes are
+    padded to the session buckets so the set of compiled programs
+    stays small; padded instances run NOP programs and are sliced off
+    by the caller."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(mask, gather, host_ok, present, sat, ops_t, args_t):
+        valid = jnp.where(gather >= 0,
+                          mask[jnp.clip(gather, 0, mask.shape[0] - 1)],
+                          host_ok) & present
+        n, n_i = present.shape
+        n_p = sat.shape[2]
+        init = (jnp.zeros((n, n_i), bool),
+                jnp.zeros((n, STACK_SLOTS, n_i), bool),
+                jnp.zeros(n, jnp.int32),
+                jnp.zeros((n, STACK_SLOTS), jnp.int32),
+                jnp.zeros(n, jnp.int32),
+                jnp.zeros(n, bool))
+
+        def body(state, opa):
+            opc, a = opa
+            sat_col = jnp.take_along_axis(
+                sat, jnp.clip(a, 0, n_p - 1)[:, None, None],
+                axis=2)[:, :, 0]
+            return _step(jnp, state, opc, a, sat_col, valid), None
+
+        state, _ = jax.lax.scan(body, init, (ops_t, args_t))
+        return state[-1]
+
+    return jax.jit(run)
+
+
+def _pow2_at_least(n: int, floor: int) -> int:
+    n = max(n, floor)
+    return 1 << (n - 1).bit_length()
+
+
+# ---------------------------------------------------------------------------
+# The per-block session
+# ---------------------------------------------------------------------------
+
+class TensorPending:
+    """The tensor path's PendingEval twin: `finish(mask)` reads the
+    instance's precomputed verdict from the session's single evaluator
+    pass (the mask argument is accepted for interface parity; the
+    session is bound to the same block mask by the validator)."""
+
+    __slots__ = ("_session", "_idx")
+
+    def __init__(self, session: "TensorSession", idx: int):
+        self._session = session
+        self._idx = idx
+
+    def finish(self, mask) -> bool:
+        return self._session.verdict(self._idx)
+
+
+class TensorSession:
+    """All policy evaluations of one block as dense tensors.
+
+    Lifecycle (driven by TxValidator):
+      stage(...)    per prepared policy: register (program, identities,
+                    verdict slots); returns a TensorPending or None
+                    (non-tensorizable -> caller falls back to closures)
+      finalize()    build the block tensors; the MSP principal matrix
+                    is computed here (under the policy_gather span)
+      attach_mask() bind the block's batch-verify mask; a jax device
+                    mask dispatches the jitted program immediately
+                    (fused downstream, no host round trip), a host
+                    mask defers to the numpy interpreter
+      verdicts()    the (N,) verdict vector, computed exactly once
+    """
+
+    def __init__(self, msp_mgr, seq: int = 0,
+                 memo: Optional[PrincipalMemo] = None):
+        self._msp_mgr = msp_mgr
+        self._seq = seq
+        self._memo = memo if memo is not None else \
+            principal_memo_for(msp_mgr)
+        self._staged: List[Tuple[TensorProgram, list, list]] = []
+        self._tensors = None
+        self._mask: Optional[np.ndarray] = None
+        self._lazy = None
+        self._verdicts: Optional[np.ndarray] = None
+        self.fallbacks = 0
+
+    def __len__(self) -> int:
+        return len(self._staged)
+
+    # -- staging ---------------------------------------------------------
+    def stage(self, program: Optional[TensorProgram], idents: list,
+              slots: list) -> Optional[TensorPending]:
+        """Register one policy evaluation.  None (with the fallback
+        counter bumped) when this evaluation cannot ride the tensors —
+        the caller keeps its closure PendingEval."""
+        fb, inst, _h, _m = _metrics()
+        if (program is None or len(idents) > MAX_IDENTS
+                or not all(self._memo.usable(i) for i in idents)):
+            # non-tensorizable tree, too many identities, or an
+            # identity the principal memo cannot key (idemix) — the
+            # caller keeps its closure PendingEval
+            self.fallbacks += 1
+            fb.add(1)
+            return None
+        inst.add(1)
+        idx = len(self._staged)
+        self._staged.append((program, idents, slots))
+        return TensorPending(self, idx)
+
+    # -- tensor build (the policy_gather sub-stage) ----------------------
+    def finalize(self) -> None:
+        if self._tensors is not None or not self._staged:
+            return
+        n = len(self._staged)
+        n_i = max(1, max(len(idents) for _p, idents, _s in self._staged))
+        n_p = max(1, max(len(p.principals)
+                         for p, _i, _s in self._staged))
+        n_t = max(1, max(p.n_ops for p, _i, _s in self._staged))
+        gather = np.full((n, n_i), -1, np.int32)
+        host_ok = np.zeros((n, n_i), bool)
+        present = np.zeros((n, n_i), bool)
+        sat = np.zeros((n, n_i, n_p), bool)
+        ops = np.zeros((n, n_t), np.int32)
+        args = np.zeros((n, n_t), np.int32)
+        memo, mgr, seq = self._memo, self._msp_mgr, self._seq
+        # block-local probe cache: a 1k-tx block re-asks the same few
+        # (identity, principal) pairs thousands of times — answer the
+        # repeats with one dict hit instead of a locked memo probe
+        # (identity objects are stable across txs via the msp cache)
+        local: dict = {}
+        for row, (prog, idents, slots) in enumerate(self._staged):
+            ops[row, :prog.n_ops] = prog.ops
+            args[row, :prog.n_ops] = prog.args
+            for i, (ident, (bidx, hok)) in enumerate(zip(idents, slots)):
+                present[row, i] = True
+                if bidx is not None:
+                    gather[row, i] = bidx
+                else:
+                    host_ok[row, i] = bool(hok)
+                for p, (principal, pbytes) in enumerate(
+                        zip(prog.principals, prog.principal_bytes)):
+                    lkey = (id(ident), pbytes)
+                    got = local.get(lkey)
+                    if got is None:
+                        got = memo.satisfied(mgr, ident, principal,
+                                             pbytes, seq)
+                        local[lkey] = got
+                    if got:
+                        sat[row, i, p] = True
+        self._tensors = (gather, host_ok, present, sat, ops, args)
+
+    # -- mask binding + evaluation ---------------------------------------
+    def attach_mask(self, raw) -> None:
+        """Bind the block's verify mask.  `raw` is whatever the
+        verifier's resolver produced: a jax device array (the fused
+        path — the jitted program is dispatched against it HERE,
+        before the validator's host sync, so verify and policy overlap
+        on device) or a host array (numpy interpreter at verdicts())."""
+        if self._verdicts is not None or not self._staged:
+            return
+        self.finalize()
+        if isinstance(raw, (np.ndarray, list, tuple)):
+            self._mask = np.asarray(raw, bool)
+            return
+        # device-resident mask: pad + dispatch the jitted program now
+        # (async); verdicts() syncs the result
+        import jax.numpy as jnp
+        gather, host_ok, present, sat, ops, args = self._pad_for_device()
+        mask_len = int(raw.shape[0]) if raw.ndim else 0
+        pad_m = _pow2_at_least(mask_len, 64)
+        mask_dev = jnp.zeros(pad_m, bool)
+        if mask_len:
+            mask_dev = mask_dev.at[:mask_len].set(raw.astype(bool))
+        self._lazy = _jax_eval_fn()(
+            mask_dev, jnp.asarray(gather), jnp.asarray(host_ok),
+            jnp.asarray(present), jnp.asarray(sat),
+            jnp.asarray(np.ascontiguousarray(ops.T)),
+            jnp.asarray(np.ascontiguousarray(args.T)))
+
+    def _pad_for_device(self):
+        """Pad the tight tensors to the bucketed jit shapes (bounded
+        compile count; padded rows are NOP programs)."""
+        gather, host_ok, present, sat, ops, args = self._tensors
+        n, n_i = present.shape
+        pn = _pow2_at_least(n, 8)
+        pt = _pow2_at_least(ops.shape[1], 16)
+
+        def pad(a, shape):
+            out = np.zeros(shape, a.dtype)
+            out[tuple(slice(0, s) for s in a.shape)] = a
+            return out
+
+        # gather pads with 0 (not -1): padded slots are present=False,
+        # so their `valid` lanes are False whatever they gather
+        return (pad(gather, (pn, MAX_IDENTS)),
+                pad(host_ok, (pn, MAX_IDENTS)),
+                pad(present, (pn, MAX_IDENTS)),
+                pad(sat, (pn, MAX_IDENTS, MAX_PRINCIPALS)),
+                pad(ops, (pn, pt)), pad(args, (pn, pt)))
+
+    def verdicts(self) -> np.ndarray:
+        """The (N,) verdict vector; computed exactly once."""
+        if self._verdicts is not None:
+            return self._verdicts
+        if not self._staged:
+            self._verdicts = np.zeros(0, bool)
+            return self._verdicts
+        if self._lazy is not None:
+            self._verdicts = np.asarray(self._lazy, bool)[:len(self)]
+        else:
+            if self._mask is None:
+                raise RuntimeError(
+                    "tensor session evaluated before its verify mask "
+                    "was attached (resolve_mask must run first)")
+            gather, host_ok, present, sat, ops, args = self._tensors
+            mask = self._mask
+            if mask.size:
+                valid = np.where(gather >= 0,
+                                 mask[np.clip(gather, 0, mask.size - 1)],
+                                 host_ok) & present
+            else:
+                valid = host_ok & present
+            self._verdicts = eval_numpy(valid, sat, ops, args)
+        return self._verdicts
+
+    def verdict(self, idx: int) -> bool:
+        return bool(self.verdicts()[idx])

@@ -203,6 +203,11 @@ declare("FABRIC_MOD_TPU_BREAKER_PROBE_S", "float", 5.0,
 declare("FABRIC_MOD_TPU_COMMIT_PIPELINE", "int", 0,
         "pipeline depth for the gossip drain loop and "
         "Channel.store_block; 0/unset = synchronous")
+declare("FABRIC_MOD_TPU_TENSOR_POLICY", "bool", None,
+        "1 evaluates a whole block's policy verdicts as dense "
+        "mask/threshold tensors in one program fused downstream of "
+        "the batch verify (non-tensorizable trees fall back per "
+        "policy); unset = the closure path")
 
 # -- ordering / ingress -----------------------------------------------------
 declare("FABRIC_MOD_TPU_BROADCAST_RETRY_S", "float", 5.0,

@@ -70,6 +70,18 @@ MATRIX = [
      {}, 1200),
     ("marshal", ["--metric", "marshal"], {}, 300),
     ("block", ["--metric", "block"], {}, 1200),
+    # tensor-vs-closure policy A/B with the DEVICE verifier: the
+    # fused mask->policy program (verify_many_fused_async hands the
+    # device-resident mask to the jitted tensor evaluator, no host
+    # round trip) gets its first on-chip number, verdicts gated
+    # identical to the closure walk before any rate
+    ("policyeval", ["--metric", "policyeval", "--tensor-policy", "1"],
+     {}, 1200),
+    # commitpipe with the tensor path armed on hardware: the commit
+    # bucket's policy share (stage_attribution.commit_policy_share)
+    # measured with the device verifier + fused policy program
+    ("commitpipe_tensor", ["--metric", "commitpipe",
+                           "--tensor-policy", "1"], {}, 1500),
     ("e2e", ["--metric", "e2e"], {}, 1500),
     ("idemix", ["--metric", "idemix"], {}, 1500),
     ("gossip", ["--metric", "gossip"], {}, 900),
@@ -101,8 +113,9 @@ MATRIX = [
       "FMT_TRACE_JAX_PROFILE": os.path.join(OUTDIR, "jaxprof")}, 1500),
     # FMT_TRACE-armed e2e: the stage-attribution breakdown
     # (recv/unpack/der_marshal/device_dispatch/verdict_await/
-    # policy_eval/mvcc/ledger_write) recorded on hardware, so the
-    # vectorized-policy/MVCC roadmap item points at a measured number
+    # policy_gather/policy_device/policy_finish/mvcc/ledger_write)
+    # recorded on hardware, so the vectorized-policy/MVCC roadmap
+    # item points at a measured number
     ("e2e_traced",
      ["--metric", "e2e", "--trace-out",
       os.path.join(OUTDIR, "e2e_trace.json")],

@@ -86,13 +86,28 @@ FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
 FMT_TRACE=1 FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
     -p no:cacheprovider -p no:randomly -m 'not slow' \
     tests/test_tracing.py tests/test_commitpipe.py
+# 0f. the tensor-policy slice: the randomized tree differential
+#     (tensor verdicts == closure verdicts incl. the greedy used-flag
+#     edge cases), the numpy-vs-jax evaluator identity, the
+#     non-tensorizable fallback path, the batch spine-decode
+#     value-identity + fuzz, and the block-level differential through
+#     the real validator — the tensor compiler is re-proven against
+#     the closures on every change
+JAX_PLATFORMS=cpu python -m pytest -q \
+    -p no:cacheprovider -p no:randomly -m 'not slow' \
+    tests/test_tensorpolicy.py tests/test_protos.py
 # CPU XLA compiles of the verify cores run multiple minutes each (the
 # persistent compile cache is TPU-oriented); give the worker room.
 export FABRIC_MOD_TPU_BENCH_TIMEOUT="${FABRIC_MOD_TPU_BENCH_TIMEOUT:-2400}"
 # broadcaststorm: the ingress admission A/B (gated vs ungated 4x
 # overload burst, consistency gate: zero admitted-then-lost, sheds
 # typed) — host-only, small N, bounded wall time
+# commitpipe runs TENSOR-ARMED (--tensor-policy 1): its gates then
+# include the tensor-vs-closure txflags + state-fingerprint identity
+# on top of the pipelined/sync/traced differentials; policyeval is
+# the dedicated tensor-vs-closure A/B over one mixed-verdict block
 exec python bench.py --cpu --batch "${SMOKE_BATCH:-64}" --reps 1 \
     --metric diffverify --metric hashverify \
-    --metric commitpipe --commitpipe-verifier sw \
+    --metric commitpipe --commitpipe-verifier sw --tensor-policy 1 \
+    --metric policyeval --policyeval-verifier sw \
     --metric broadcaststorm

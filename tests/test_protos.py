@@ -124,3 +124,123 @@ def test_rwset_roundtrip():
     # zero-valued version (genesis reads) survives
     kv0 = m.KVRWSet(reads=[m.KVRead(key="x", version=None)])
     assert m.KVRWSet.decode(kv0.encode()).reads[0].version is None
+
+
+# --- batch spine decode (protos/batchdecode.py) ----------------------------
+
+def _spine_envelopes(n=24):
+    envs = []
+    for i in range(n):
+        ch = protoutil.make_channel_header(
+            3, "chan%d" % (i % 3), tx_id="tx%d" % i,
+            extension=b"ext" if i % 5 == 0 else b"")
+        sh = protoutil.make_signature_header(b"creator-%d" % i,
+                                             b"nonce-%d" % i)
+        payload = protoutil.make_payload(ch, sh, b"data" * (i % 7))
+        envs.append(m.Envelope(payload=payload.encode(),
+                               signature=b"sig%d" % i).encode())
+    return envs
+
+
+def _generic_spine(data):
+    env = m.Envelope.decode(data)
+    payload = protoutil.unmarshal_envelope_payload(env)
+    ch = m.ChannelHeader.decode(payload.header.channel_header)
+    sh = m.SignatureHeader.decode(payload.header.signature_header)
+    return env, payload.data, ch, sh
+
+
+def test_batchdecode_identical_to_generic():
+    from fabric_mod_tpu.protos import batchdecode
+    datas = _spine_envelopes()
+    rows = batchdecode.decode_block_spine(datas)
+    assert all(r is not None for r in rows)
+    for d, row in zip(datas, rows):
+        env, pdata, ch, sh = _generic_spine(d)
+        assert row.env == env
+        assert row.payload.data == pdata
+        assert row.ch == ch
+        assert row.sh == sh
+
+
+def test_batchdecode_malformed_rows_fall_back():
+    from fabric_mod_tpu.protos import batchdecode
+    datas = _spine_envelopes(12)
+    datas[1] = b"\xff\xff\xff"            # bad tag stream
+    datas[3] = datas[3][:-4]              # truncated
+    datas[5] = b""                        # empty
+    datas[7] = datas[7] + b"\x00"         # trailing garbage
+    datas[9] = m.Envelope(payload=b"", signature=b"s").encode()
+    rows = batchdecode.decode_block_spine(datas)
+    for i in (1, 3, 5, 7, 9):
+        assert rows[i] is None            # the generic path decides
+    for i in (0, 2, 4, 6, 8, 10, 11):
+        assert rows[i] is not None
+        assert rows[i].env == m.Envelope.decode(datas[i])
+
+
+def test_batchdecode_fuzz_never_disagrees():
+    """Random byte mutations: every row the scanner ACCEPTS must be
+    value-identical to the generic decoder; rejected rows are the
+    generic decoder's business (soundness over completeness)."""
+    import random
+    from fabric_mod_tpu.protos import batchdecode
+    rng = random.Random(42)
+    base = _spine_envelopes(6)
+    for _ in range(150):
+        datas = []
+        for _j in range(8):
+            d = bytearray(rng.choice(base))
+            for _k in range(rng.randrange(0, 4)):
+                d[rng.randrange(len(d))] = rng.randrange(256)
+            datas.append(bytes(d))
+        rows = batchdecode.decode_block_spine(datas)
+        for d, row in zip(datas, rows):
+            if row is None:
+                continue
+            env, pdata, ch, sh = _generic_spine(d)   # must not raise
+            assert row.env == env and row.payload.data == pdata
+            assert row.ch == ch and row.sh == sh
+
+
+def test_batchdecode_duplicate_fields_fall_back():
+    """The generic decoder parses EVERY occurrence of a submessage/
+    string field (raising on a malformed non-last one); last-wins
+    acceptance is only sound for single occurrences, so the scanner
+    sends any duplicated known field to the per-tx fallback."""
+    from fabric_mod_tpu.protos import batchdecode
+    from fabric_mod_tpu.protos.wire import _write_len_delim
+    ch = protoutil.make_channel_header(3, "c", tx_id="t")
+    sh = protoutil.make_signature_header(b"cr", b"no")
+    p1 = protoutil.make_payload(ch, sh, b"first").encode()
+    p2 = protoutil.make_payload(ch, sh, b"second").encode()
+    out = bytearray()
+    _write_len_delim(out, 1, p1)
+    _write_len_delim(out, 1, p2)
+    _write_len_delim(out, 2, b"sig")
+    datas = [bytes(out)] * 4
+    rows = batchdecode.decode_block_spine(datas)
+    assert all(r is None for r in rows)
+    # generic decode still accepts (keeps the last occurrence) — the
+    # fallback, not the scanner, owns the verdict
+    assert m.Envelope.decode(datas[0]).payload == p2
+
+    # the review repro: payload.header duplicated with a MALFORMED
+    # first occurrence — the generic path raises (BAD_PAYLOAD), so
+    # the scanner must never accept such a row
+    from fabric_mod_tpu.protos.wire import Msg
+    pay = bytearray()
+    _write_len_delim(pay, 1, b"\x0b")          # wire-malformed Header
+    _write_len_delim(pay, 1, m.Header(channel_header=ch.encode(),
+                                      signature_header=sh.encode()
+                                      ).encode())
+    _write_len_delim(pay, 2, b"data")
+    env = bytearray()
+    _write_len_delim(env, 1, bytes(pay))
+    _write_len_delim(env, 2, b"sig")
+    datas = [bytes(env)] * 4
+    rows = batchdecode.decode_block_spine(datas)
+    assert all(r is None for r in rows)
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        m.Payload.decode(m.Envelope.decode(datas[0]).payload)

@@ -1,0 +1,563 @@
+"""Tensor policy evaluation: the tensor-vs-closure differential.
+
+The tensor compiler (policy/tensorpolicy.py) must reproduce the
+closure compiler's greedy used-flag semantics EXACTLY — these tests
+pin that with seeded randomized policy trees (nested NOutOf depths,
+duplicate principals, over/under-satisfied identity sets, mixed
+batch/host verdict slots), the named greedy edge cases (greedy is not
+maximal matching; a failed child must not consume identities), the
+numpy-vs-jax evaluator identity, the non-tensorizable fallback path,
+and block-level differentials through the real TxValidator (tier-1 at
+small scale, the 1k-tx 2-of-3 block slow-marked).
+"""
+import random
+
+import numpy as np
+import pytest
+
+from fabric_mod_tpu.policy import cauthdsl
+from fabric_mod_tpu.policy import tensorpolicy as tp
+from fabric_mod_tpu.protos import messages as m
+
+V = m.TxValidationCode
+
+
+# ---------------------------------------------------------------------------
+# fakes: principal satisfaction as a lookup table, no crypto
+# ---------------------------------------------------------------------------
+
+class FakeIdent:
+    def __init__(self, key):
+        self.key = key
+        self.mspid = "fake"
+        self.cert = None
+
+
+class FakeMgr:
+    """satisfies_principal from a (ident key, principal byte) table."""
+
+    def __init__(self, table):
+        self.table = table
+        self.calls = 0
+
+    def satisfies_principal(self, ident, principal):
+        self.calls += 1
+        return self.table.get(
+            (ident.key, principal.principal[0] if principal.principal
+             else -1), False)
+
+
+class FakeMemo:
+    """PrincipalMemo stand-in for FakeIdent (no real certs)."""
+
+    def usable(self, ident):
+        return True
+
+    def satisfied(self, mgr, ident, principal, pbytes, seq):
+        return mgr.satisfies_principal(ident, principal)
+
+
+def _leaf(i):
+    return m.SignaturePolicy(signed_by=i)
+
+
+def _nout(n, *rules):
+    return m.SignaturePolicy(n_out_of=m.NOutOf(n=n, rules=list(rules)))
+
+
+def _envelope(rule, n_prins):
+    prins = [m.MSPPrincipal(principal_classification=1,
+                            principal=bytes([j])) for j in range(n_prins)]
+    return m.SignaturePolicyEnvelope(rule=rule, identities=prins)
+
+
+def _closure_verdict(env, mgr, idents, valid_mask):
+    closure = cauthdsl._compile(env.rule, env.identities, mgr)
+    vid = [i for i, ok in zip(idents, valid_mask) if ok]
+    return closure(vid, [False] * len(vid))
+
+
+def _tensor_verdict(env, mgr, idents, valid_mask):
+    prog = tp.compile_tensor_program(env)
+    assert prog is not None
+    session = tp.TensorSession(mgr, memo=FakeMemo())
+    # mixed batch/host slots: even slots gather from the mask, odd
+    # slots carry a host verdict — both paths must behave identically
+    mask = []
+    slots = []
+    for i, ok in enumerate(valid_mask):
+        if i % 2 == 0:
+            slots.append((len(mask), False))
+            mask.append(ok)
+        else:
+            slots.append((None, ok))
+    pending = session.stage(prog, idents, slots)
+    assert pending is not None
+    session.attach_mask(np.asarray(mask, bool))
+    return pending.finish(None)
+
+
+# ---------------------------------------------------------------------------
+# 1. seeded property-style differential over randomized trees
+# ---------------------------------------------------------------------------
+
+def _rand_tree(rng, n_prins, depth=0):
+    # depth cap PAST the compiler's MAX_DEPTH: every nesting level the
+    # compiler can accept must be differentialed (the cstack-overflow
+    # class of bug lives exactly at the deepest accepted level)
+    if depth > tp.MAX_DEPTH or rng.random() < 0.45:
+        return _leaf(rng.randrange(n_prins))
+    k = rng.randrange(1, 4)
+    subs = [_rand_tree(rng, n_prins, depth + 1) for _ in range(k)]
+    # n deliberately ranges past k: over-threshold nodes must fail in
+    # both compilers
+    return _nout(rng.randrange(0, k + 2), *subs)
+
+
+def test_randomized_tree_differential():
+    rng = random.Random(20260804)
+    ran = skipped = 0
+    for _ in range(800):
+        n_prins = rng.randrange(1, 5)
+        env = _envelope(_rand_tree(rng, n_prins), n_prins)
+        if tp.compile_tensor_program(env) is None:
+            skipped += 1              # over the caps: fallback path
+            continue
+        ran += 1
+        n_id = rng.randrange(0, 6)
+        idents = [FakeIdent(i) for i in range(n_id)]
+        # duplicate principals / over- and under-satisfied sets come
+        # from the random table densities
+        table = {(i, j): rng.random() < 0.5
+                 for i in range(n_id) for j in range(n_prins)}
+        mgr = FakeMgr(table)
+        valid = [rng.random() < 0.7 for _ in range(n_id)]
+        want = _closure_verdict(env, mgr, idents, valid)
+        got = _tensor_verdict(env, mgr, idents, valid)
+        assert got == want, (env, table, valid)
+    assert ran > 600               # the differential actually ran
+    # caps themselves are pinned in test_non_tensorizable_trees (the
+    # LEAFC fusion shrank programs enough that these random shapes
+    # all fit)
+    assert ran + skipped == 800
+
+
+# ---------------------------------------------------------------------------
+# 2. the greedy used-flag edge cases, pinned explicitly
+# ---------------------------------------------------------------------------
+
+def test_greedy_is_not_maximal_matching():
+    """OutOf(2, A, B) with id0 satisfying BOTH and id1 only A: greedy
+    gives id0 to leaf A first, leaf B finds nobody — False, even
+    though the maximal matching (id1->A, id0->B) exists.  The tensor
+    program must reproduce the greedy (reference) answer."""
+    env = _envelope(_nout(2, _leaf(0), _leaf(1)), 2)
+    idents = [FakeIdent(0), FakeIdent(1)]
+    table = {(0, 0): True, (0, 1): True, (1, 0): True, (1, 1): False}
+    mgr = FakeMgr(table)
+    assert _closure_verdict(env, mgr, idents, [True, True]) is False
+    assert _tensor_verdict(env, mgr, idents, [True, True]) is False
+
+
+def test_failed_child_does_not_consume():
+    """OutOf(1, OutOf(2, A, B), A) with ONE identity satisfying only
+    A: the inner 2-of fails after its A-leaf consumed the identity —
+    the consumption must roll back so the outer A-leaf still finds
+    it.  A broken trial/commit discipline returns False."""
+    env = _envelope(_nout(1, _nout(2, _leaf(0), _leaf(1)), _leaf(0)), 2)
+    idents = [FakeIdent(0)]
+    table = {(0, 0): True, (0, 1): False}
+    mgr = FakeMgr(table)
+    assert _closure_verdict(env, mgr, idents, [True]) is True
+    assert _tensor_verdict(env, mgr, idents, [True]) is True
+
+
+def test_no_early_exit_matches_reference_used_set():
+    """An NOutOf keeps running children after the threshold is met
+    (reference cauthdsl.go:45-60); a later sibling therefore sees the
+    extra consumption.  OutOf(1, A, A) then A again at the outer
+    level with two A-capable identities: inner consumes BOTH."""
+    env = _envelope(_nout(2, _nout(1, _leaf(0), _leaf(0)), _leaf(0)), 1)
+    idents = [FakeIdent(0), FakeIdent(1)]
+    table = {(0, 0): True, (1, 0): True}
+    mgr = FakeMgr(table)
+    want = _closure_verdict(env, mgr, idents, [True, True])
+    got = _tensor_verdict(env, mgr, idents, [True, True])
+    assert got == want
+
+
+def test_invalid_identities_never_satisfy():
+    env = _envelope(_leaf(0), 1)
+    idents = [FakeIdent(0)]
+    mgr = FakeMgr({(0, 0): True})
+    assert _tensor_verdict(env, mgr, idents, [False]) is False
+    assert _tensor_verdict(env, mgr, idents, [True]) is True
+
+
+# ---------------------------------------------------------------------------
+# 3. caps + fallback
+# ---------------------------------------------------------------------------
+
+def test_non_tensorizable_trees_return_none():
+    # depth cap counts SAVE nesting (fused leaf children are free):
+    # MAX_DEPTH+1 levels of non-leaf nesting still fit, one more not
+    deep = _leaf(0)
+    for _ in range(tp.MAX_DEPTH + 1):
+        deep = _nout(1, deep)
+    assert tp.compile_tensor_program(_envelope(deep, 1)) is not None
+    # and the deepest ACCEPTED shape must also EVALUATE correctly —
+    # the counter stack holds one more level than the SAVE frames
+    env = _envelope(deep, 1)
+    mgr = FakeMgr({(0, 0): True})
+    idents = [FakeIdent(0)]
+    assert _closure_verdict(env, mgr, idents, [True]) is True
+    assert _tensor_verdict(env, mgr, idents, [True]) is True
+    assert tp.compile_tensor_program(
+        _envelope(_nout(1, deep), 1)) is None
+    wide = _nout(1, *[_leaf(0)] * (tp.MAX_OPS + 1))
+    assert tp.compile_tensor_program(_envelope(wide, 1)) is None
+    many = _envelope(_leaf(0), tp.MAX_PRINCIPALS + 1)
+    assert tp.compile_tensor_program(many) is None
+    # out-of-range signed_by: the closure compiler raises, the tensor
+    # compiler declines (the caller's closure path surfaces the error)
+    assert tp.compile_tensor_program(_envelope(_leaf(7), 2)) is None
+
+
+def test_session_fallback_counted():
+    mgr = FakeMgr({})
+    session = tp.TensorSession(mgr, memo=FakeMemo())
+    assert session.stage(None, [FakeIdent(0)], [(None, True)]) is None
+    assert session.fallbacks == 1
+    too_many = [FakeIdent(i) for i in range(tp.MAX_IDENTS + 1)]
+    prog = tp.compile_tensor_program(_envelope(_leaf(0), 1))
+    assert session.stage(prog, too_many,
+                         [(None, True)] * len(too_many)) is None
+    assert session.fallbacks == 2
+
+
+def test_certless_identity_falls_back_with_real_memo():
+    """Identities without a cert (idemix pseudonyms — the non-P256
+    host-verdict lanes) cannot be memo-keyed: the evaluation must
+    fall back to closures instead of crashing the block's
+    finalize()."""
+    mgr = FakeMgr({})
+    session = tp.TensorSession(mgr, memo=tp.PrincipalMemo())
+    prog = tp.compile_tensor_program(_envelope(_leaf(0), 1))
+    certless = FakeIdent(0)               # .cert is None
+    assert session.stage(prog, [certless], [(None, True)]) is None
+    assert session.fallbacks == 1
+    session.attach_mask(np.zeros(0, bool))   # no instances: no-op
+    assert len(session) == 0
+
+
+# ---------------------------------------------------------------------------
+# 4. numpy evaluator == jitted jax evaluator
+# ---------------------------------------------------------------------------
+
+def test_numpy_vs_jax_evaluator_identical():
+    rng = random.Random(99)
+    progs = []
+    while len(progs) < 23:
+        n_prins = rng.randrange(1, 5)
+        p = tp.compile_tensor_program(
+            _envelope(_rand_tree(rng, n_prins), n_prins))
+        if p is not None:
+            progs.append(p)
+    mask = np.asarray([rng.random() < 0.6 for _ in range(50)], bool)
+
+    class TableMemo:
+        """Deterministic satisfaction keyed by (ident key, principal
+        bytes) so both sessions see the same matrix."""
+
+        def __init__(self):
+            self._rng = random.Random(5)
+            self._t = {}
+
+        def usable(self, ident):
+            return True
+
+        def satisfied(self, mgr, ident, principal, pbytes, seq):
+            key = (ident.key, pbytes)
+            if key not in self._t:
+                self._t[key] = self._rng.random() < 0.5
+            return self._t[key]
+
+    rng2 = random.Random(7)
+    staged = []
+    for p in progs:
+        k = rng2.randrange(0, 5)
+        idents = [FakeIdent((id(p), i)) for i in range(k)]
+        slots = []
+        for i in range(k):
+            if rng2.random() < 0.8:
+                slots.append((rng2.randrange(50), False))
+            else:
+                slots.append((None, rng2.random() < 0.5))
+        staged.append((p, idents, slots))
+
+    def build_session():
+        s = tp.TensorSession(FakeMgr({}), memo=TableMemo())
+        for p, idents, slots in staged:
+            assert s.stage(p, idents, slots) is not None
+        return s
+
+    jax = pytest.importorskip("jax")
+    jnp = jax.numpy
+    s_np = build_session()
+    s_np.attach_mask(mask)
+    host = s_np.verdicts()
+
+    s_jx = build_session()
+    s_jx.attach_mask(jnp.asarray(mask))
+    dev = s_jx.verdicts()
+    assert s_jx._lazy is not None      # the jitted program actually ran
+    assert np.array_equal(host, dev)
+
+
+# ---------------------------------------------------------------------------
+# 5. the principal memo
+# ---------------------------------------------------------------------------
+
+def test_principal_memo_one_msp_call_per_pair(world):
+    mgr = world["mgr"]
+    memo = tp.PrincipalMemo()
+    env = m.ApplicationPolicy.decode(_default_policy()).signature_policy
+    pol = cauthdsl.CompiledPolicy(env, mgr)
+    prog = pol.tensor_program()
+    assert prog is not None
+
+    class Counting:
+        def __init__(self, inner):
+            self.inner = inner
+            self.calls = 0
+
+        def satisfies_principal(self, ident, principal):
+            self.calls += 1
+            return self.inner.satisfies_principal(ident, principal)
+
+    counting = Counting(mgr)
+    o = world["orgs"]
+    idents = [mgr.deserialize_identity(o[n]["peer"].serialize())
+              for n in ("Org1", "Org2")]
+    for p, pb in zip(prog.principals, prog.principal_bytes):
+        for ident in idents:
+            memo.satisfied(counting, ident, p, pb, seq=1)
+    first = counting.calls
+    assert first == len(prog.principals) * len(idents)
+    for p, pb in zip(prog.principals, prog.principal_bytes):
+        for ident in idents:
+            memo.satisfied(counting, ident, p, pb, seq=1)
+    assert counting.calls == first            # all hits
+    # a config-sequence bump is a clean miss
+    memo.satisfied(counting, idents[0], prog.principals[0],
+                   prog.principal_bytes[0], seq=2)
+    assert counting.calls == first + 1
+
+
+def test_compile_policy_bytes_memoized(world):
+    from fabric_mod_tpu.policy.manager import compile_policy_bytes
+    env_bytes = m.ApplicationPolicy.decode(
+        _default_policy()).signature_policy.encode()
+    a = compile_policy_bytes(env_bytes, world["mgr"], 3)
+    b = compile_policy_bytes(env_bytes, world["mgr"], 3)
+    assert a is b
+    c = compile_policy_bytes(env_bytes, world["mgr"], 4)
+    assert c is not a                 # sequence keys the memo
+
+
+# ---------------------------------------------------------------------------
+# 6. block-level differentials through the real TxValidator
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def world():
+    from fabric_mod_tpu.bccsp.sw import SwCSP
+    from fabric_mod_tpu.msp import ca as calib
+    from fabric_mod_tpu.msp.identities import SigningIdentity
+    from fabric_mod_tpu.msp.mspimpl import Msp, MspManager
+
+    csp = SwCSP()
+    orgs, msps = {}, []
+    for name in ("Org1", "Org2", "Org3"):
+        ca = calib.CA(f"ca.{name.lower()}", name)
+        msps.append(Msp(name, csp, [ca.cert]))
+
+        def mk(cn, ous, _ca=ca, _n=name):
+            cert, key = _ca.issue(cn, _n, ous=ous)
+            return SigningIdentity(_n, cert, calib.key_pem(key), csp)
+
+        orgs[name] = dict(peer=mk(f"peer0.{name.lower()}", ["peer"]),
+                          client=mk(f"user@{name.lower()}", ["client"]))
+    return dict(csp=csp, orgs=orgs, mgr=MspManager(msps))
+
+
+def _default_policy() -> bytes:
+    from fabric_mod_tpu.policy import from_string
+    return m.ApplicationPolicy(signature_policy=from_string(
+        "OutOf(2, 'Org1.peer', 'Org2.peer', 'Org3.peer')")).encode()
+
+
+def _mixed_block(world, n_txs):
+    """Valid, under-endorsed, duplicate-endorser, and tampered-
+    signature lanes — flags must carry signal, not all-VALID."""
+    from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder
+    from fabric_mod_tpu.protos import protoutil
+
+    o = world["orgs"]
+    envs = []
+    for i in range(n_txs):
+        b = RWSetBuilder()
+        b.add_write("mycc", f"k{i}", b"v%d" % i)
+        if i % 7 == 3:
+            endorsers = [o["Org1"]["peer"]]              # under 2-of-3
+        elif i % 7 == 5:
+            endorsers = [o["Org1"]["peer"], o["Org1"]["peer"]]
+        else:
+            endorsers = [o["Org1"]["peer"], o["Org2"]["peer"]]
+        env = protoutil.create_signed_tx(
+            "testchannel", "mycc", b.build().encode(),
+            o["Org1"]["client"], endorsers)
+        if i % 11 == 9:
+            env.signature = bytes(reversed(env.signature))  # bad creator
+        envs.append(env)
+    return protoutil.new_block(0, b"", envs)
+
+
+def _validator(world, verifier=None):
+    from fabric_mod_tpu.bccsp.sw import SwCSP
+    from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
+    from fabric_mod_tpu.peer import TxValidator, ValidationInfoProvider
+    from fabric_mod_tpu.policy import ApplicationPolicyEvaluator
+
+    return TxValidator(
+        "testchannel", world["mgr"],
+        ApplicationPolicyEvaluator(world["mgr"]),
+        verifier or FakeBatchVerifier(SwCSP()),
+        ValidationInfoProvider(_default_policy()))
+
+
+def _block_differential(world, monkeypatch, n_txs):
+    block = _mixed_block(world, n_txs)
+    monkeypatch.delenv("FABRIC_MOD_TPU_TENSOR_POLICY", raising=False)
+    closure_flags = _validator(world).validate(block)
+    monkeypatch.setenv("FABRIC_MOD_TPU_TENSOR_POLICY", "1")
+    tensor_staged = _validator(world).stage(block)
+    assert tensor_staged.session is not None
+    assert len(tensor_staged.session) > 0
+    tensor_flags = tensor_staged.validator.finish(tensor_staged)
+    assert tensor_flags == closure_flags
+    assert {V.VALID, V.ENDORSEMENT_POLICY_FAILURE,
+            V.BAD_CREATOR_SIGNATURE} <= set(closure_flags)
+
+
+def test_block_differential_small(world, monkeypatch):
+    _block_differential(world, monkeypatch, 46)
+
+
+@pytest.mark.slow
+def test_block_differential_1k(world, monkeypatch):
+    """The acceptance shape: a 1k-tx 2-of-3 block, tensor flags
+    bit-identical to closures (slow: wheel-less signing)."""
+    _block_differential(world, monkeypatch, 1000)
+
+
+def test_knob_routes_session(world, monkeypatch):
+    block = _mixed_block(world, 8)
+    monkeypatch.delenv("FABRIC_MOD_TPU_TENSOR_POLICY", raising=False)
+    assert _validator(world).stage(block).session is None
+    monkeypatch.setenv("FABRIC_MOD_TPU_TENSOR_POLICY", "1")
+    assert _validator(world).stage(block).session is not None
+
+
+def test_commitpipe_state_differential(monkeypatch, tmp_path):
+    """Tensor-vs-closure through the FULL commit path — key-level
+    VALIDATION_PARAMETER candidates, in-block overrides, barriers —
+    per-block txflags AND state fingerprint identical."""
+    import bench
+    from fabric_mod_tpu.bccsp.sw import SwCSP
+    from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
+    from fabric_mod_tpu.peer import Committer
+
+    blocks, make_committer, _barriers = bench._commitpipe_world(8, 4)
+
+    def run(root):
+        led, validator = make_committer(FakeBatchVerifier(SwCSP()),
+                                        str(root))
+        committer = Committer(validator, led)
+        flags = [list(committer.store_block(m.Block.decode(raw)))
+                 for raw in blocks]
+        return flags, led.state_fingerprint()
+
+    monkeypatch.delenv("FABRIC_MOD_TPU_TENSOR_POLICY", raising=False)
+    f1, fp1 = run(tmp_path / "closure")
+    monkeypatch.setenv("FABRIC_MOD_TPU_TENSOR_POLICY", "1")
+    f2, fp2 = run(tmp_path / "tensor")
+    assert f1 == f2
+    assert fp1 == fp2
+    assert {f for per in f1 for f in per} != {0}
+
+
+# ---------------------------------------------------------------------------
+# 7. the fusion seam
+# ---------------------------------------------------------------------------
+
+def test_fused_device_mask_drives_jitted_program(world, monkeypatch):
+    """A verifier whose fused resolver hands back a JAX array must
+    route the session through the jitted program (no host round
+    trip), with flags identical to the closure path."""
+    jax = pytest.importorskip("jax")
+    jnp = jax.numpy
+    from fabric_mod_tpu.bccsp.sw import SwCSP
+
+    class FusedFake:
+        def __init__(self):
+            self._csp = SwCSP()
+
+        def verify_many(self, items):
+            return np.asarray(self._csp.verify_batch(items), bool)
+
+        def verify_many_fused_async(self, items):
+            return lambda: jnp.asarray(self.verify_many(items))
+
+    block = _mixed_block(world, 12)
+    monkeypatch.setenv("FABRIC_MOD_TPU_TENSOR_POLICY", "1")
+    staged = _validator(world, FusedFake()).stage(block)
+    fused_flags = staged.validator.finish(staged)
+    assert staged.session is not None
+    assert staged.session._lazy is not None    # jitted program ran
+    monkeypatch.delenv("FABRIC_MOD_TPU_TENSOR_POLICY")
+    assert fused_flags == _validator(world).validate(block)
+
+
+def test_tpu_verifier_fused_async_identical():
+    """TpuVerifier.verify_many_fused_async == verify_many verdicts
+    (incl. dedup expansion and an invalid lane); with the memo-cache
+    off the resolver's mask may stay device-resident — np.asarray of
+    it must still be the correct host view."""
+    from fabric_mod_tpu.bccsp.tpu import TpuVerifier
+    from fabric_mod_tpu.utils.fixtures import make_verify_items
+
+    items, expect = make_verify_items(6, n_keys=2, invalid_every=3,
+                                      seed=b"fused")
+    items = items + items[:2]              # dedup expansion lanes
+    expect = expect + expect[:2]
+    v = TpuVerifier(cache_size=0)
+    fused = np.asarray(v.verify_many_fused_async(items)(), bool)
+    plain = np.asarray(v.verify_many(items), bool)
+    assert list(fused) == list(plain) == expect
+
+    # with the DEFAULT memo-cache enabled, an all-miss batch still
+    # takes the fused handoff; the deferred .writeback() populates
+    # the cache at the consumer's sync point, and the next (all-hit)
+    # batch resolves host-side with identical verdicts
+    vc = TpuVerifier(cache_size=64)
+    resolver = vc.verify_many_fused_async(items)
+    assert hasattr(resolver, "writeback")   # all-miss: fused handoff
+    got = np.asarray(resolver(), bool)
+    assert list(got) == expect
+    assert len(vc._cache) == 0              # write-back not yet run
+    resolver.writeback()
+    assert len(vc._cache) == 6              # unique items memoized
+    warm = vc.verify_many_fused_async(items)
+    assert not hasattr(warm, "writeback")   # cache hits: host branch
+    assert list(np.asarray(warm(), bool)) == expect

@@ -232,11 +232,11 @@ def test_commitpipe_armed_vs_unarmed_differential(commitpipe_world,
     for t in tls:
         names = {s["name"] for s in t["subs"]}
         assert {"unpack", "device_dispatch", "verdict_await",
-                "policy_eval", "mvcc", "ledger_write"} <= names, \
+                "policy_finish", "mvcc", "ledger_write"} <= names, \
             f"block {t['block']} timeline incomplete: {names}"
     # sub-stage totals cover the named commit-path split
     totals = tracing.substage_totals()
-    for name in ("unpack", "verdict_await", "policy_eval", "mvcc",
+    for name in ("unpack", "verdict_await", "policy_finish", "mvcc",
                  "ledger_write"):
         assert totals[name]["count"] >= len(blocks)
 
@@ -256,7 +256,7 @@ def test_sync_committer_records_timeline(commitpipe_world, tmp_path):
     tls = tracing.recorder().timelines()
     assert len(tls) == 1 and tls[0]["consumer"] == "sync"
     names = {s["name"] for s in tls[0]["subs"]}
-    assert {"unpack", "verdict_await", "policy_eval", "mvcc",
+    assert {"unpack", "verdict_await", "policy_finish", "mvcc",
             "ledger_write"} <= names
 
 
