@@ -702,8 +702,16 @@ class BatchingVerifyService:
                                         structure="BatchingVerifyService")
         self._worker.start()
 
-    def submit(self, item: VerifyItem) -> Future:
+    def submit(self, item: VerifyItem, tag=None) -> Future:
+        """`tag` rides the Future through the flusher untouched here;
+        routing subclasses (sharding.CrossChannelVerifyService) read
+        it in `_route_batch` to split one coalesced batch into
+        per-slice dispatch groups.  It must be attached BEFORE the
+        enqueue — the flusher may drain the item the instant the put
+        lands."""
         fut: Future = Future()
+        if tag is not None:
+            fut._fmt_shard_tag = tag
         if tracing.armed():
             # the caller's trace context rides the Future through the
             # GuardedQueue handoff: the flusher/resolver threads link
@@ -721,7 +729,7 @@ class BatchingVerifyService:
         return fut
 
     def verify_many(self, items: Sequence[VerifyItem],
-                    timeout=_DEADLINE_KNOB):
+                    timeout=_DEADLINE_KNOB, tag=None):
         """The policy-engine seam (same shape as TpuVerifier): submit
         each item and gather verdicts.  Concurrent callers' items
         coalesce into shared device batches — this is how ingress
@@ -732,10 +740,11 @@ class BatchingVerifyService:
         FABRIC_MOD_TPU_VERIFY_DEADLINE knob (explicit None waits
         forever).  On expiry every still-pending Future fails with
         VerifyDeadlineExceeded — typed, so callers can tell a deadline
-        from a device failure — and the call raises it."""
+        from a device failure — and the call raises it.  `tag` is the
+        routing label (see `submit`)."""
         if timeout is _DEADLINE_KNOB:
             timeout = verify_deadline_s()
-        futs = [self.submit(it) for it in items]
+        futs = [self.submit(it, tag=tag) for it in items]
         deadline = (time.monotonic() + timeout
                     if timeout is not None else None)
         out = []
@@ -811,12 +820,22 @@ class BatchingVerifyService:
 
     # -- worker side: accumulate + dispatch -------------------------------
 
+    def _route_batch(self, batch):
+        """Split one coalesced batch into dispatch groups
+        ``[(verifier, subbatch)]``.  The base service is a single
+        program: everything goes to the one verifier.  The sharding
+        subsystem's cross-channel service overrides this to group by
+        the submit tag's mesh slice — one flusher, per-slice fused
+        dispatches."""
+        return [(self._verifier, batch)]
+
     def _flush(self, batch) -> None:
         """Marshal + dispatch one batch, then hand it to the resolver.
-        Marshalling failures fail the batch's Futures here; device
+        Marshalling failures fail the affected GROUP's Futures here
+        (a routed batch dispatches group-by-group, and one channel's
+        bad marshal must not fail another channel's riders); device
         failures surface on the resolver thread."""
         self._batch_hist.observe(len(batch))
-        items = [b[0] for b in batch]
         # stitch the flush span under the FIRST traced submitter (a
         # coalesced batch has many parents; one link beats none, and
         # the span's items attr says how many riders shared it)
@@ -828,26 +847,41 @@ class BatchingVerifyService:
                 None)
         flush_span = tracing.span("verify.flush", parent=parent,
                                   items=len(batch))
-        try:
-            with flush_span:
-                async_fn = getattr(self._verifier,
-                                   "verify_many_async", None)
-                if async_fn is not None:
-                    resolve = async_fn(items)
-                else:
-                    mask = self._verifier.verify_many(items)
-                    resolve = lambda: mask           # noqa: E731
-        except Exception as e:
-            for _, fut in batch:
-                _complete(fut, exc=e)
-            return
-        # Bounded in-flight window: blocks when `inflight_depth`
-        # batches are already executing — backpressure on the worker.
-        # Gauge BEFORE put: the dispatched batch is in flight even
-        # while the put blocks, and incrementing after would race the
-        # resolver's decrement below zero.
-        self._inflight_gauge.add(1)
-        self._inflight.put((batch, resolve, flush_span.ctx))
+        dispatched = []
+        with flush_span:
+            # the span covers routing + marshal + dispatch ONLY — the
+            # backpressure puts below may block on the in-flight
+            # window, and that queue-wait is resolver backlog, not
+            # flush cost (the PR 9 attribution reads this span)
+            try:
+                groups = self._route_batch(batch)
+            except Exception as e:
+                for _, fut in batch:
+                    _complete(fut, exc=e)
+                return
+            for verifier, group in groups:
+                items = [b[0] for b in group]
+                try:
+                    async_fn = getattr(verifier,
+                                       "verify_many_async", None)
+                    if async_fn is not None:
+                        resolve = async_fn(items)
+                    else:
+                        mask = verifier.verify_many(items)
+                        resolve = lambda m=mask: m   # noqa: E731
+                except Exception as e:
+                    for _, fut in group:
+                        _complete(fut, exc=e)
+                    continue
+                dispatched.append((group, resolve))
+        for group, resolve in dispatched:
+            # Bounded in-flight window: blocks when `inflight_depth`
+            # batches are already executing — backpressure on the
+            # worker.  Gauge BEFORE put: the dispatched batch is in
+            # flight even while the put blocks, and incrementing
+            # after would race the resolver's decrement below zero.
+            self._inflight_gauge.add(1)
+            self._inflight.put((group, resolve, flush_span.ctx))
 
     def _run(self) -> None:
         pending: list[tuple[VerifyItem, Future]] = []

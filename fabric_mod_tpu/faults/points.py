@@ -33,6 +33,7 @@ DECLARED_POINTS: Set[str] = {
     "gossip.comm.send",
     "orderer.admission.overload",
     "orderer.raft.submit",
+    "sharding.dispatch",
 }
 
 

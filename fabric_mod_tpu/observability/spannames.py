@@ -29,6 +29,7 @@ DECLARED_SPANS: Set[str] = {
     "policy_finish",
     "policy_gather",
     "recv",
+    "shard.dispatch",
     "unpack",
     "verdict_await",
     "verify.flush",

@@ -4,4 +4,5 @@ See mesh.py for the design rationale; SURVEY.md §2.9 maps the
 reference's goroutine-per-tx fan-out to the batch axis sharded here.
 """
 from fabric_mod_tpu.parallel.mesh import (  # noqa: F401
-    data_mesh, fused_verify_shardings, replicated, verify_shardings)
+    data_mesh, fused_verify_shardings, replicated, slice_meshes,
+    verify_shardings)

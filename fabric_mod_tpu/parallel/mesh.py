@@ -14,7 +14,18 @@ verification is embarrassingly parallel across items (SURVEY.md §5.7:
 batch is the only parallel axis; nothing rides ICI except the result).
 
 Multi-host later: the same mesh spec over jax.distributed processes;
-the sharding annotations do not change.
+the sharding annotations do not change (the concrete process-group
+spec lives in sharding/multihost.py, stubbed behind
+FABRIC_MOD_TPU_SHARDS).
+
+A THIRD axis landed with the sharding subsystem (sharding/):
+horizontal CHANNEL placement.  `data_mesh` accepts an explicit device
+subset and `slice_meshes` carves the device set into disjoint
+equal-size slices — one per channel shard — so K chips x N channels
+run N independent verify/policy programs side by side instead of one
+channel's program owning every chip.  Slices never share devices;
+each slice's programs keep the exact NamedShardings above, just over
+fewer devices.
 
 A SECOND, host-side parallel axis composes with the mesh since the
 commit pipeline landed (peer/commitpipe.py): with pipeline depth >= 2,
@@ -32,16 +43,59 @@ from typing import Optional
 import numpy as np
 
 
-def data_mesh(n_devices: Optional[int] = None):
-    """A 1-D ``("dp",)`` mesh over the first `n_devices` devices."""
+def data_mesh(n_devices: Optional[int] = None, devices=None):
+    """A 1-D ``("dp",)`` mesh over the first `n_devices` devices, or —
+    for SLICE meshes — over an explicit `devices` subset (any iterable
+    of jax devices; order is the dp order).  The two selectors are
+    mutually exclusive."""
     import jax
     from jax.sharding import Mesh
 
+    if devices is not None:
+        if n_devices is not None:
+            raise ValueError("pass n_devices OR devices, not both")
+        devs = list(devices)
+        if not devs:
+            raise ValueError("empty device subset")
+        if len(set(devs)) != len(devs):
+            raise ValueError("duplicate devices in subset")
+        return Mesh(np.array(devs), ("dp",))
     devs = jax.devices()
     n = n_devices or len(devs)
     if n > len(devs):
         raise ValueError(f"asked for {n} devices, have {len(devs)}")
     return Mesh(np.array(devs[:n]), ("dp",))
+
+
+def slice_meshes(n_slices: int, n_devices: Optional[int] = None):
+    """Carve the first `n_devices` devices (default: all) into
+    `n_slices` DISJOINT contiguous equal-size ``("dp",)`` meshes — the
+    placement primitive of the channel-sharding subsystem
+    (sharding/shardmap.py): each channel shard owns one slice, so N
+    channels' verify/policy programs run side by side without sharing
+    a chip.  Contiguous split on purpose: adjacent device ids sit on
+    the same ICI neighborhood, so a slice's final verdict gather never
+    crosses another slice's links.
+
+    The device count must divide evenly — a ragged split would give
+    slices different bucket divisibility (bccsp.tpu._bucket pads the
+    batch axis to a multiple of the mesh size) and two channels'
+    otherwise-identical programs would stop being shape-identical.
+    """
+    import jax
+
+    if n_slices <= 0:
+        raise ValueError("n_slices must be positive")
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, have {len(devs)}")
+    if n % n_slices != 0:
+        raise ValueError(
+            f"{n} devices do not split into {n_slices} equal slices")
+    per = n // n_slices
+    return [data_mesh(devices=devs[i * per:(i + 1) * per])
+            for i in range(n_slices)]
 
 
 def verify_shardings(mesh):

@@ -53,6 +53,7 @@ class Channel:
         self._plugin_registry = plugin_registry
         self._lock = RegisteredLock("peer.channel._lock")
         self._commit_pipe = None           # lazy; see commit_pipeline()
+        self._shard_router = None          # set via use_shard_router()
         # serializes pipe (re)builds: never held by pipe worker
         # threads, so the unbounded drain-join inside cannot deadlock
         self._pipe_rebuild_lock = RegisteredLock("peer.channel._pipe_rebuild_lock")
@@ -227,9 +228,35 @@ class Channel:
         flags = self.validator().validate(block)
         return self.ledger.commit_block(block, flags)
 
+    def use_shard_router(self, router) -> None:
+        """Bind this channel to a ChannelShardRouter (sharding/):
+        commit_pipeline() then delegates to the router's slice-pinned
+        engine — the router carries the same rebuild-on-poison
+        contract, plus placement.  The router must already hold this
+        channel (add_channel); binding is one-way for the channel's
+        lifetime (unbinding mid-stream would race two engines onto
+        one ledger).  A knob-built pipe that predates the binding is
+        DRAINED here first, for the same reason — and the router
+        target binds only AFTER that drain, so a direct router caller
+        (submit_block/pipeline_for) cannot build the slice engine
+        while the old one still commits."""
+        with self._pipe_rebuild_lock:
+            with self._lock:
+                old, self._commit_pipe = self._commit_pipe, None
+            if old is not None:
+                old.close()
+            # only after the old engine fully drained: from here on
+            # the router may build, and every commit_pipeline() caller
+            # gets, the slice-pinned engine
+            router.bind_target(self.channel_id, self)
+            with self._lock:
+                self._shard_router = router
+
     def commit_pipeline(self):
         """The channel's shared PipelinedCommitter when the
-        FABRIC_MOD_TPU_COMMIT_PIPELINE knob enables one, else None.
+        FABRIC_MOD_TPU_COMMIT_PIPELINE knob enables one (or a shard
+        router is bound — router-bound channels always pipeline,
+        pinned to their slice), else None.
         Shared so every commit producer on this channel (gossip drain,
         store_block callers) feeds ONE in-order pipeline.
 
@@ -242,6 +269,10 @@ class Channel:
         the old engine FIRST (unbounded close, outside self._lock so
         an in-flight config_apply can still take it) — two engines
         never run against the ledger at once."""
+        with self._lock:
+            router = self._shard_router
+        if router is not None:
+            return router.pipeline_for(self.channel_id)
         from fabric_mod_tpu.peer.commitpipe import pipeline_depth
         depth = pipeline_depth()
         if depth <= 0:
@@ -255,6 +286,13 @@ class Channel:
         if pipe is not None:
             return pipe                    # hot path: no rebuild lock
         with self._pipe_rebuild_lock:
+            with self._lock:
+                router = self._shard_router
+            if router is not None:
+                # a use_shard_router() bind landed while we waited on
+                # the rebuild lock: building a knob pipe now would put
+                # a second engine on the ledger — delegate instead
+                return router.pipeline_for(self.channel_id)
             pipe = healthy()
             if pipe is not None:
                 return pipe                # another caller rebuilt

@@ -376,6 +376,14 @@ class PipelinedCommitter:
     def closed(self) -> bool:
         return self._closed
 
+    @property
+    def consumer(self) -> str:
+        """The occupancy-gauge label this engine reports under.  The
+        shard router labels per slice ("shard0", "shard1", ...), so
+        /metrics shows each slice's pipeline fill separately — the
+        placement-balance view next to the router's channels gauge."""
+        return self._consumer
+
     # -- stage loop: host unpack + device dispatch -----------------------
     def _stage_loop(self) -> None:
         try:

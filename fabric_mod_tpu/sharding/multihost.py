@@ -1,0 +1,93 @@
+"""Multi-host sharding spec: jax.distributed-shaped process groups,
+the SAME NamedShardings, a host-side router only.  Documented and
+STUBBED behind FABRIC_MOD_TPU_SHARDS — single-host slice meshes are
+the shipping path; this module pins down what multi-host adds so the
+day hardware with >1 host is reachable nothing has to be redesigned.
+
+The design (why nothing above this layer changes):
+
+* **Devices.** Each host process runs ``jax.distributed.initialize``
+  and sees the global device list; ``parallel.slice_meshes`` carves
+  the GLOBAL list exactly as it carves a local one — a slice may span
+  hosts (its limb/flag NamedShardings are host-agnostic; GSPMD
+  inserts the cross-host collectives) or sit entirely on one host
+  (the preferred placement: a channel's verify gather then never
+  leaves the host's ICI domain).  ``FABRIC_MOD_TPU_SHARD_HOSTS``
+  declares the expected process count so a misconfigured fleet fails
+  loudly at spec time instead of hanging in a collective.
+* **The router stays host-side and per-process.**  Every host runs
+  its own ChannelShardRouter over the slices whose devices it
+  PREFERS (process_index-partitioned round robin below); channel
+  placement is deterministic (ShardMap is a pure function of the
+  join/leave sequence), so all hosts agree on the map without a
+  coordination service.  Blocks arrive per channel via gossip/deliver
+  exactly as on one host — ordering is the orderer's job, not the
+  mesh's.
+* **The shared verify service stays per-host.**  Cross-channel
+  coalescing is a HOST-side latency optimization (one flusher per
+  process); items never need to cross hosts to batch, because every
+  host only verifies traffic it already holds.
+
+What is genuinely NOT built yet (the stub below raises): the
+jax.distributed bring-up itself (coordinator address plumbing,
+restart semantics under the soak harness's churn) and multi-host
+placement of a single slice's fused program on real ICI.  Both are
+measurement-gated — the scale curve in MULTICHIP_r*.json decides
+whether cross-host slices are ever worth their collectives.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from fabric_mod_tpu.utils import knobs
+
+
+def multihost_spec(n_hosts: int = None, n_slices: int = None) -> Dict:
+    """The process-group spec the multi-host bring-up will follow:
+    pure arithmetic (no jax — DEVICE counts are a bring-up-time
+    reality this spec deliberately does not guess at), so tests pin
+    the shape today.
+
+    Returns {hosts, slices, slices_per_host, process_groups:
+    [{process_index, slices: [...]}], shardings, router} — slices are
+    round-robin partitioned over hosts by preference; NamedShardings
+    are unchanged by design (the whole point)."""
+    if n_hosts is None:
+        n_hosts = max(1, knobs.get_int("FABRIC_MOD_TPU_SHARD_HOSTS"))
+    if n_slices is None:
+        n_slices = max(1, knobs.get_int("FABRIC_MOD_TPU_SHARDS", 1))
+    if n_slices % n_hosts != 0:
+        raise ValueError(
+            f"{n_slices} slices do not partition over {n_hosts} hosts "
+            f"evenly — pad the slice count, not the fleet")
+    groups: List[Dict] = []
+    for p in range(n_hosts):
+        groups.append({
+            "process_index": p,
+            "slices": list(range(p, n_slices, n_hosts)),
+        })
+    return {
+        "hosts": n_hosts,
+        "slices": n_slices,
+        "slices_per_host": n_slices // n_hosts,
+        "process_groups": groups,
+        # the load-bearing invariants, recorded in the artifact so a
+        # future bring-up can diff its reality against the spec
+        "shardings": "identical NamedShardings (P(None,'dp') limbs, "
+                     "P('dp') flags) over the global mesh",
+        "router": "host-side, per-process, deterministic ShardMap",
+    }
+
+
+def initialize_multihost() -> None:
+    """The bring-up stub: raises until the multi-host path is built.
+    Gated on FABRIC_MOD_TPU_SHARD_HOSTS > 1 so single-host callers
+    (everything today) pass through as a no-op."""
+    n_hosts = knobs.get_int("FABRIC_MOD_TPU_SHARD_HOSTS")
+    if n_hosts <= 1:
+        return
+    raise NotImplementedError(
+        "multi-host sharding is specified (sharding/multihost.py) but "
+        "not yet brought up: jax.distributed.initialize plumbing and "
+        "churn-safe restart semantics land with the first multi-host "
+        f"hardware window (asked for {n_hosts} hosts)")

@@ -1,0 +1,126 @@
+"""The shared cross-channel verify front door: one flusher, per-slice
+fused dispatches, tagged futures routing verdicts back per channel.
+
+`parallel.fused_verify_shardings` generalized from one program to a
+SERVICE: the base :class:`BatchingVerifyService` already coalesces
+concurrent submitters into deadline/size-batched dispatches against
+ONE verifier; this subclass keeps that single flusher (one deadline
+clock, one coalescing window for the whole process) and splits each
+coalesced batch at flush time into per-slice groups — each group one
+fused dispatch on its slice's mesh via that slice's verifier.  The
+submit tag (the channel id) picks the group through the shard map, so
+
+* a small channel's stray verifies ride the same flush window as a
+  big channel's storm instead of each paying its own dispatch
+  latency (the whole point of sharing the front door), and
+* per-slice groups FAIL independently: a marshal error or injected
+  fault on channel A's group completes only A's futures with the
+  error — channel B's riders in the same flush window resolve
+  normally (the isolation contract the sharding tests pin).
+
+Whole-block batches do NOT come through here: the router pins each
+channel's validator to its slice verifier directly (they are already
+full fused dispatches; coalescing them would only serialize slices).
+This service is the small-verify lane: gossip block verifies, config
+signature sets, broadcast filters.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Sequence
+
+from fabric_mod_tpu import faults
+from fabric_mod_tpu.bccsp.api import VerifyItem
+from fabric_mod_tpu.bccsp.tpu import (BatchingVerifyService,
+                                      _DEADLINE_KNOB)
+from fabric_mod_tpu.observability import tracing
+from fabric_mod_tpu.observability.metrics import (MetricOpts,
+                                                  default_provider)
+
+_GROUPS_OPTS = MetricOpts(
+    "fabric", "sharding", "dispatch_groups_total",
+    help="Per-slice dispatch groups cut from coalesced cross-channel "
+         "flush batches.", label_names=("slice",))
+
+
+class _SliceLane:
+    """One slice's dispatch lane: the slice verifier plus the chaos
+    seam and span every routed group passes through.  Kept verifier-
+    shaped so the base flusher dispatches it like any verifier."""
+
+    def __init__(self, index: int, verifier):
+        self.index = index
+        self.verifier = verifier
+        self._m_groups = default_provider().counter(
+            _GROUPS_OPTS).with_labels(str(index))
+
+    def verify_many_async(self, items: Sequence[VerifyItem]):
+        # chaos seam: an injected failure here kills exactly one
+        # slice-group of one flush — the cross-channel isolation
+        # contract under test (other channels' futures must resolve)
+        faults.point("sharding.dispatch")
+        self._m_groups.add(1)
+        with tracing.span("shard.dispatch", slice=self.index,
+                          items=len(items)):
+            fn = getattr(self.verifier, "verify_many_async", None)
+            if fn is not None:
+                return fn(items)
+            mask = self.verifier.verify_many(items)
+            return lambda: mask
+
+
+class CrossChannelVerifyService(BatchingVerifyService):
+    """BatchingVerifyService over a DICT of per-slice verifiers.
+
+    `verifiers`: slice index -> verifier (TpuVerifier pinned to that
+    slice's mesh in production; any verify_many[_async]-shaped object
+    in tests/host mode).  `shard_of(tag) -> slice`: the placement
+    lookup (ShardMap.slice_of with a default) — it must ACCEPT
+    unknown tags (route them to a default slice) rather than raise,
+    because one stray tag must never fail a whole coalesced batch.
+    Untagged submits route to `default_slice`.
+
+    Verifier LIFECYCLE stays with the caller (the router): slices are
+    shared with the per-channel block path, so close() here tears
+    down only the flusher/resolver threads.
+    """
+
+    def __init__(self, verifiers: Dict[int, object],
+                 shard_of: Callable[[object], int],
+                 default_slice: int = 0, **kwargs):
+        if not verifiers:
+            raise ValueError("need at least one slice verifier")
+        if default_slice not in verifiers:
+            raise ValueError(
+                f"default slice {default_slice} has no verifier")
+        self._lanes = {i: _SliceLane(i, v)
+                       for i, v in verifiers.items()}
+        self._shard_of = shard_of
+        self._default_slice = default_slice
+        super().__init__(verifier=self._lanes[default_slice], **kwargs)
+        # the base class would close a verifier it built; ours are the
+        # router's (shared with the block path) — never owned here
+        self._owns_verifier = False
+
+    # -- per-channel surface ---------------------------------------------
+    def submit_for(self, channel_id: str, item: VerifyItem):
+        return self.submit(item, tag=channel_id)
+
+    def verify_many_for(self, channel_id: str,
+                        items: Sequence[VerifyItem],
+                        timeout=_DEADLINE_KNOB):
+        return self.verify_many(items, timeout=timeout, tag=channel_id)
+
+    # -- the routed flush -------------------------------------------------
+    def _route_batch(self, batch):
+        """Group one coalesced batch by mesh slice.  Slice order is
+        sorted so the dispatch order (and with it the resolver's
+        completion order) is deterministic for a given batch."""
+        groups: Dict[int, list] = {}
+        for item, fut in batch:
+            tag = getattr(fut, "_fmt_shard_tag", None)
+            s = (self._default_slice if tag is None
+                 else self._shard_of(tag))
+            if s not in self._lanes:
+                s = self._default_slice
+            groups.setdefault(s, []).append((item, fut))
+        return [(self._lanes[s], groups[s]) for s in sorted(groups)]

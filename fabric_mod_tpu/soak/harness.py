@@ -334,6 +334,8 @@ class SoakHarness:
                 for k, v in checker.recovery_by_kind.items()},
             "peers_final": len(world.peers),
             "channels": world.channel_ids,
+            # FMT_SOAK_SHARDED: churn rode the per-peer shard routers
+            "sharded": world.sharded,
         }
         if trace_t0 is not None:
             # commit-path stage attribution across the whole run (the

@@ -143,11 +143,31 @@ class SoakPeer:
         self.channels: Dict[str, Channel] = {}
         self.nodes: Dict[str, GossipNode] = {}
         self.services: Dict[str, GossipService] = {}
+        # opt-in sharded-channel mode (FMT_SOAK_SHARDED): this peer's
+        # channels place onto host-mode slices behind one per-peer
+        # ChannelShardRouter — gossip drains feed slice-pinned commit
+        # pipes and every MCS/config verify rides the shared
+        # cross-channel service, so the seeded churn (joins, config
+        # swaps, leader kills, armed faults) exercises the sharding
+        # subsystem's placement + isolation instead of the bare
+        # synchronous path
+        self.router = None
+        if world.sharded:
+            from fabric_mod_tpu.sharding import ChannelShardRouter
+            self.router = ChannelShardRouter(
+                n_slices=max(1, min(2, len(world.channel_ids))),
+                verifier_factory=lambda i, mesh: FakeBatchVerifier(
+                    world.csp))
         for cid in world.channel_ids:
             ledger = self.ledger_mgr.create_or_open(cid)
             _, config = config_from_block(world.genesis[cid])
-            channel = Channel(cid, ledger, FakeBatchVerifier(world.csp),
+            verifier = (self.router.add_channel(cid)
+                        if self.router is not None
+                        else FakeBatchVerifier(world.csp))
+            channel = Channel(cid, ledger, verifier,
                               Bundle(cid, config, world.csp), world.csp)
+            if self.router is not None:
+                channel.use_shard_router(self.router)
             if ledger.height == 0:
                 channel.init_from_genesis(world.genesis[cid])
             self.channels[cid] = channel
@@ -174,6 +194,11 @@ class SoakPeer:
             svc.stop()
         for node in self.nodes.values():
             node.stop()
+        if self.router is not None:
+            # after the services' final drains: the router close joins
+            # every slice-pinned pipe and the shared flusher before
+            # the ledgers they write go away
+            self.router.close()
         self.ledger_mgr.close()
 
 
@@ -223,6 +248,8 @@ class SoakWorld:
         self.root = str(root)
         self.seed = int(seed)
         self.csp = SwCSP()
+        from fabric_mod_tpu.utils import knobs as _knobs
+        self.sharded = _knobs.get_bool("FMT_SOAK_SHARDED")
         self.orgs = list(orgs)
         self.channel_ids = [f"soak{i}" for i in range(n_channels)]
         self.clock = ManualClock()

@@ -41,6 +41,65 @@ def make_verify_items(
     return items, expect
 
 
+def make_channel_stream(signers, cid: str, n_blocks: int,
+                        txs_per_block: int,
+                        under_endorse_every: int = 4,
+                        namespace: str = "mycc") -> List[bytes]:
+    """One channel's encoded block stream for the sharding
+    differentials — the SINGLE oracle stream generator shared by
+    bench.py --metric multichannel and tests/test_sharding.py, so the
+    two can never gate against drifted streams: every
+    `under_endorse_every`-th tx is endorsed 1-of-3 (fails a 2-of-3
+    policy -> the flags carry signal), keys are per-channel
+    (`{cid}-b{n}t{j}` holding `cid`) so fingerprints differ across
+    channels.  `signers` maps org -> SigningIdentity for Org1/Org2
+    (Org1 is the creator)."""
+    from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder
+    from fabric_mod_tpu.protos import protoutil
+
+    blocks, prev = [], b""
+    for n in range(n_blocks):
+        envs = []
+        for j in range(txs_per_block):
+            b = RWSetBuilder()
+            b.add_write(namespace, f"{cid}-b{n}t{j}", cid.encode())
+            endorsers = (
+                ("Org1",)
+                if (n * txs_per_block + j) % under_endorse_every
+                == under_endorse_every - 1
+                else ("Org1", "Org2"))
+            envs.append(protoutil.create_signed_tx(
+                cid, namespace, b.build().encode(), signers["Org1"],
+                [signers[o] for o in endorsers]))
+        blk = protoutil.new_block(n, prev, envs)
+        prev = protoutil.block_header_hash(blk.header)
+        blocks.append(blk.encode())
+    return blocks
+
+
+def independent_baseline(streams, make_target) -> dict:
+    """The sharding differentials' oracle: per channel, an INDEPENDENT
+    unsharded synchronous run of its stream into a fresh ledger —
+    returns {cid: (per_block_flags, state_fingerprint, wall_secs)}.
+    `make_target(cid)` builds a fresh ValidatorCommitTarget-shaped
+    (validator, ledger) pair with its own unsharded verifier."""
+    import time
+
+    from fabric_mod_tpu.peer.txvalidator import Committer
+    from fabric_mod_tpu.protos import messages as m
+
+    out = {}
+    for cid, raws in streams.items():
+        t = make_target(cid)
+        committer = Committer(t.validator, t.ledger)
+        t0 = time.perf_counter()
+        flags = [list(committer.store_block(m.Block.decode(raw)))
+                 for raw in raws]
+        out[cid] = (flags, t.ledger.state_fingerprint(),
+                    time.perf_counter() - t0)
+    return out
+
+
 def signature_arrays(
         n: int, tamper_last: bool = True,
         seed: bytes = b"fixture") -> Tuple[np.ndarray, ...]:

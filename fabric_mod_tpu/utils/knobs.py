@@ -158,6 +158,11 @@ declare("FMT_SOAK_IDEMIX_GAP_S", "float", 1.0,
         "idemix lane inter-tx gap (s)")
 declare("FMT_SOAK_FAULT_P", "float", 0.05,
         "background fault probability per injection-point pass")
+declare("FMT_SOAK_SHARDED", "bool", None,
+        "1 routes every soak peer's channels through a per-peer "
+        "ChannelShardRouter (host-mode slices + the shared "
+        "cross-channel verify service) so churn rides the sharding "
+        "subsystem")
 
 # -- device / kernel routing ------------------------------------------------
 declare("FABRIC_MOD_TPU_MIXED_ADD", "bool", None,
@@ -208,6 +213,20 @@ declare("FABRIC_MOD_TPU_TENSOR_POLICY", "bool", None,
         "mask/threshold tensors in one program fused downstream of "
         "the batch verify (non-tensorizable trees fall back per "
         "policy); unset = the closure path")
+
+# -- channel sharding -------------------------------------------------------
+declare("FABRIC_MOD_TPU_SHARDS", "int", 0,
+        "mesh slices the channel-shard router carves (sharding/); "
+        "0/unset = sharding disabled (single-slice behavior)")
+declare("FABRIC_MOD_TPU_SHARD_DEPTH", "int", 0,
+        "per-channel commit-pipeline depth under the shard router; "
+        "0 = fall back to FABRIC_MOD_TPU_COMMIT_PIPELINE, defaulting "
+        "to depth 2 when that is unset too (floor 1 — router-bound "
+        "channels always pipeline)")
+declare("FABRIC_MOD_TPU_SHARD_HOSTS", "int", 1,
+        "expected jax.distributed process count of the multi-host "
+        "spec (sharding/multihost.py); >1 is specified but stubbed — "
+        "initialize_multihost raises until the bring-up lands")
 
 # -- ordering / ingress -----------------------------------------------------
 declare("FABRIC_MOD_TPU_BROADCAST_RETRY_S", "float", 5.0,

@@ -89,6 +89,20 @@ MATRIX = [
      {}, 900),
     ("gossip_nocache", ["--metric", "gossip", "--memo-cache", "0"],
      {}, 900),
+    # the storm growth curve toward 500 peers (metric names carry the
+    # count, so each lands as its own best-of record)
+    ("gossip_150peer", ["--metric", "gossip", "--peers", "150"],
+     {}, 1200),
+    ("gossip_500peer", ["--metric", "gossip", "--peers", "500"],
+     {}, 1800),
+    # channel-sharded scale-out: N channels on mesh slices behind the
+    # shared cross-channel verify service; per-channel txflags +
+    # fingerprints gate bit-identical sharded-vs-independent before
+    # any rate, then the (slices x channels x peers) scale curve is
+    # captured and persist() writes it through to MULTICHIP_rTPU.json
+    # — the on-chip answer to whether K chips x N channels aggregate
+    ("multichannel", ["--metric", "multichannel", "--slices", "4",
+                      "--channels", "4", "--peers", "50"], {}, 2400),
     # host-only but captured alongside: the ingress admission A/B
     # (gated vs ungated overload burst + consistency gate)
     ("broadcaststorm", ["--metric", "broadcaststorm", "--batch", "512"],
@@ -187,6 +201,29 @@ def persist(rec):
         json.dump(rec, f, indent=1)
     if rec.get("platform") != "tpu":
         return
+    if rec.get("metric", "").startswith("multichannel"):
+        # the MULTICHIP record grows up: not the bare {n_devices, ok}
+        # dryrun stub, but the real scale curve — aggregate committed
+        # tx/s per (slices x channels x peers) point, identity-gated
+        # sharded-vs-independent by the bench before the rates were
+        # reported.  One file, overwritten per capture: the curve is
+        # a property of the hardware window, not a best-of race.
+        multichip = {
+            "n_devices": rec.get("n_devices"),
+            "ok": True,
+            "platform": "tpu",
+            "agg_tx_per_sec": rec.get("value"),
+            "serial_independent_tx_per_sec": rec.get(
+                "serial_independent_tx_per_sec"),
+            "axes": rec.get("axes"),
+            "points": rec.get("points"),
+            "sharded_vs_independent_identical": rec.get(
+                "sharded_vs_independent_identical"),
+            "capture_time": rec.get("capture_time"),
+        }
+        with open(os.path.join(REPO, "MULTICHIP_rTPU.json"), "w") as f:
+            json.dump(multichip, f, indent=1)
+        log("multichannel scale curve -> MULTICHIP_rTPU.json")
     # best-of per metric at repo root, tpu-only
     best_path = os.path.join(REPO, f"BENCH_BEST_{rec['metric']}.json")
     try:

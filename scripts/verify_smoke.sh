@@ -96,6 +96,19 @@ FMT_TRACE=1 FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
 JAX_PLATFORMS=cpu python -m pytest -q \
     -p no:cacheprovider -p no:randomly -m 'not slow' \
     tests/test_tensorpolicy.py tests/test_protos.py
+# 0g. the shard slice, FMT_RACECHECK=1 over 8 fake host devices (the
+#     conftest forces xla_force_host_platform_device_count=8): slice
+#     meshes carve the virtual device set and run the REAL
+#     multi-device sharding path, the tagged cross-channel flusher
+#     routes per-slice groups, and the sharded-vs-independent
+#     differential (per-channel txflags + state fingerprints
+#     bit-identical) plus both isolation contracts (injected fault /
+#     tamper on channel A never perturbs B; a poisoned per-channel
+#     pipe never wedges the shared flusher) run with every race
+#     guard armed
+FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
+    -p no:cacheprovider -p no:randomly -m 'not slow' \
+    tests/test_sharding.py tests/test_parallel.py
 # CPU XLA compiles of the verify cores run multiple minutes each (the
 # persistent compile cache is TPU-oriented); give the worker room.
 export FABRIC_MOD_TPU_BENCH_TIMEOUT="${FABRIC_MOD_TPU_BENCH_TIMEOUT:-2400}"
@@ -106,8 +119,13 @@ export FABRIC_MOD_TPU_BENCH_TIMEOUT="${FABRIC_MOD_TPU_BENCH_TIMEOUT:-2400}"
 # include the tensor-vs-closure txflags + state-fingerprint identity
 # on top of the pipelined/sync/traced differentials; policyeval is
 # the dedicated tensor-vs-closure A/B over one mixed-verdict block
+# multichannel: the channel-sharded scale sweep on host-mode slices
+# (sw verifiers, no XLA) — every point's per-channel txflags + state
+# fingerprints gate bit-identical sharded-vs-N-independent-unsharded
+# before any rate lands in the curve
 exec python bench.py --cpu --batch "${SMOKE_BATCH:-64}" --reps 1 \
     --metric diffverify --metric hashverify \
     --metric commitpipe --commitpipe-verifier sw --tensor-policy 1 \
     --metric policyeval --policyeval-verifier sw \
-    --metric broadcaststorm
+    --metric broadcaststorm \
+    --metric multichannel --multichannel-verifier sw --peers 8
