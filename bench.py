@@ -1110,11 +1110,40 @@ def _storm_material(n_clients: int, max_message_count: int,
             "orderer_signer": orderer_signer}
 
 
-def _storm_channel(root: str, mat: dict):
+def _storm_channel(root: str, mat: dict, verify_many=None):
     from fabric_mod_tpu.orderer import Registrar
-    registrar = Registrar(root, mat["orderer_signer"], mat["csp"])
+    registrar = Registrar(root, mat["orderer_signer"], mat["csp"],
+                          verify_many=verify_many)
     support = registrar.create_channel(mat["genesis"])
     return registrar, support
+
+
+def _storm_device_verifier(staged_batch: int):
+    """Build the device batch verifier for the --storm-verifier=device
+    arms: verdict memo-cache OFF (the same pre-signed envelopes replay
+    in every arm — a cache hit would fake the batch economics), and
+    the 1-item and `staged_batch`-item padding buckets warmed with
+    garbage items OUTSIDE any measured window, so arms time dispatch,
+    not XLA compiles.  Returns (verify_many, close)."""
+    from fabric_mod_tpu.bccsp.api import VerifyItem
+    from fabric_mod_tpu.bccsp.tpu import TpuVerifier
+
+    verifier = TpuVerifier(cache_size=0)
+
+    def junk(n):
+        # distinct digests: identical items would dedup to one device
+        # lane and warm the wrong bucket
+        return [VerifyItem((b"storm-warm-%08d" % i).ljust(32, b"\0"),
+                           b"\x00" * 8, b"\x00" * 64)
+                for i in range(n)]
+
+    log("storm: warming device verify buckets (1 and "
+        f"{staged_batch}-item) ...")
+    t0 = time.perf_counter()
+    verifier.verify_many(junk(1))
+    verifier.verify_many(junk(max(2, staged_batch)))
+    log(f"storm: device buckets warm in {time.perf_counter() - t0:.1f}s")
+    return verifier.verify_many, verifier.close
 
 
 def _storm_envelopes(clients, per_client: int):
@@ -1151,12 +1180,17 @@ def _storm_committed_tx_ids(store) -> list:
 
 
 def _storm_arm(root: str, envs_by_client, mat: dict, gated: bool,
-               drain_delay_s: float, queue_cap: int) -> dict:
+               drain_delay_s: float, queue_cap: int,
+               staged: int = 0, verify_many=None) -> dict:
     """One storm run: every client thread pushes its envelopes as fast
     as the ingress admits them; a sleep shim on write_block caps the
-    drain rate (the controlled overload).  Returns stats AFTER
-    asserting the invariant: every admitted envelope committed exactly
-    once, every shed answered typed."""
+    drain rate (the controlled overload; `drain_delay_s` <= 0 leaves
+    the backend unthrottled, so INGRESS is the binding resource).
+    `staged` > 0 arms the staged ingress engine at that coalescing
+    depth; `verify_many` overrides the Writers batch verifier (the
+    device arms).  Returns stats AFTER asserting the invariant: every
+    admitted envelope committed exactly once, every shed answered
+    typed."""
     import tempfile
     import threading
 
@@ -1165,21 +1199,25 @@ def _storm_arm(root: str, envs_by_client, mat: dict, gated: bool,
 
     knobs = {"FABRIC_MOD_TPU_SUBMIT_QUEUE": str(queue_cap)} if gated \
         else {}
+    if staged > 0:
+        knobs["FABRIC_MOD_TPU_STAGED_BROADCAST"] = str(staged)
     saved = {k: os.environ.pop(k, None)
              for k in ("FABRIC_MOD_TPU_SUBMIT_QUEUE",
                        "FABRIC_MOD_TPU_INGRESS_RATE",
-                       "FABRIC_MOD_TPU_SHED_LAT_S")}
+                       "FABRIC_MOD_TPU_SHED_LAT_S",
+                       "FABRIC_MOD_TPU_STAGED_BROADCAST")}
     os.environ.update(knobs)
     try:
         with tempfile.TemporaryDirectory(dir=root) as tmp:
-            registrar, support = _storm_channel(tmp, mat)
-            # drain throttle: a bounded-rate ordering backend
-            orig_write = support.writer.write_block
+            registrar, support = _storm_channel(tmp, mat, verify_many)
+            if drain_delay_s > 0:
+                # drain throttle: a bounded-rate ordering backend
+                orig_write = support.writer.write_block
 
-            def slow_write(block, _orig=orig_write):
-                time.sleep(drain_delay_s)
-                return _orig(block)
-            support.writer.write_block = slow_write
+                def slow_write(block, _orig=orig_write):
+                    time.sleep(drain_delay_s)
+                    return _orig(block)
+                support.writer.write_block = slow_write
             bcast = Broadcast(registrar)
 
             admitted, shed, errors = [], [], []
@@ -1244,6 +1282,7 @@ def _storm_arm(root: str, envs_by_client, mat: dict, gated: bool,
             stop_mon.set()
             mon.join(timeout=2)
             committed = _storm_committed_tx_ids(support.store)
+            bcast.close()          # stop any staging lanes
             registrar.close()
     finally:
         for k, v in saved.items():
@@ -1594,18 +1633,36 @@ def measure_soak(seed, n_events) -> dict:
     return rep
 
 
-def measure_broadcaststorm(n_txs: int, n_clients: int = 8) -> dict:
+def measure_broadcaststorm(n_txs: int, n_clients: int = 8,
+                           staged_batch: int = 64,
+                           storm_verifier: str = "sw") -> dict:
     """A/B overload burst through the REAL ingress (Broadcast ->
     SoloChain -> block store): gated arm (bounded queue + overload
     gate) vs the un-gated PR 6 baseline (blocking puts), same
     pre-signed envelopes, a write_block sleep shim pinning the drain
     rate to ~1/4 of the measured submit capacity (a 4x-overload
-    burst).  Both arms must pass the consistency gate — every admitted
-    envelope commits exactly once, every shed is typed — before any
-    rate is reported."""
+    burst).
+
+    With `staged_batch` > 0, a SECOND pair runs the staged-vs-unstaged
+    A/B with the drain UNTHROTTLED: the throttled pair is about what
+    admission does when the backend is the cap, the staged pair about
+    what coalescing does when INGRESS is the cap (the tentpole's
+    claim) — a throttled staged arm would just re-measure the
+    throttle.  `storm_verifier` picks the Writers batch verifier both
+    staged arms AND the throttled pair dispatch through: "sw" (host
+    ECDSA: per-item cost is flat, so staging shows its queueing win
+    only) or "device" (ops/p256 batch verify: real batch economics —
+    one padded dispatch per drain vs one per submission; buckets are
+    pre-warmed so no arm times an XLA compile).  Every arm must pass
+    the consistency gate — every admitted envelope commits exactly
+    once, every shed is typed — before any rate is reported."""
     import tempfile
 
+    requested_txs = n_txs
     n_txs = max(n_clients * 4, n_txs)
+    if n_txs != requested_txs:
+        log(f"storm: raising txs {requested_txs} -> {n_txs} "
+            f"(floor: 4 per client x {n_clients} clients)")
     per_client = n_txs // n_clients
     max_message_count = 16
 
@@ -1617,15 +1674,20 @@ def measure_broadcaststorm(n_txs: int, n_clients: int = 8) -> dict:
                 for k in ("FABRIC_MOD_TPU_SUBMIT_QUEUE",
                           "FABRIC_MOD_TPU_INGRESS_RATE",
                           "FABRIC_MOD_TPU_INGRESS_BURST",
-                          "FABRIC_MOD_TPU_SHED_LAT_S")}
+                          "FABRIC_MOD_TPU_SHED_LAT_S",
+                          "FABRIC_MOD_TPU_STAGED_BROADCAST")}
+    vm_close = None
     try:
         with tempfile.TemporaryDirectory(prefix="fmt_storm_") as root:
             mat = _storm_material(n_clients, max_message_count, "100ms")
             clients = mat["clients"]
+            vm = None
+            if storm_verifier == "device":
+                vm, vm_close = _storm_device_verifier(staged_batch)
             # calibration: the per-submit cost (Writers verify
             # dominates) sets the drain throttle for a ~4x overload
             from fabric_mod_tpu.orderer import Broadcast
-            cal_registrar, _sup = _storm_channel(root + "/cal", mat)
+            cal_registrar, _sup = _storm_channel(root + "/cal", mat, vm)
             cal_envs = _storm_envelopes(clients[:1], 16)
             cal_bcast = Broadcast(cal_registrar)
             t0 = time.perf_counter()
@@ -1633,6 +1695,7 @@ def measure_broadcaststorm(n_txs: int, n_clients: int = 8) -> dict:
                 cal_bcast.submit(env)
             per_submit_s = max(
                 1e-5, (time.perf_counter() - t0) / len(cal_envs))
+            cal_bcast.close()
             cal_registrar.close()
             drain_delay_s = 4.0 * per_submit_s * max_message_count
             offered_rate = 1.0 / per_submit_s
@@ -1654,12 +1717,25 @@ def measure_broadcaststorm(n_txs: int, n_clients: int = 8) -> dict:
                                 len(all_envs) // 4))
 
             gated = _storm_arm(root, by_client, mat, True,
-                               drain_delay_s, queue_cap)
+                               drain_delay_s, queue_cap, verify_many=vm)
             log(f"gated arm: {gated}")
             ungated = _storm_arm(root, by_client, mat, False,
-                                 drain_delay_s, queue_cap)
+                                 drain_delay_s, queue_cap, verify_many=vm)
             log(f"ungated arm: {ungated}")
+            staged = unstaged = None
+            if staged_batch > 0:
+                # the staged A/B: same gated config, drain UNTHROTTLED
+                # (ingress-limited — the resource staging changes)
+                unstaged = _storm_arm(root, by_client, mat, True,
+                                      0.0, queue_cap, verify_many=vm)
+                log(f"unstaged ingress-limited arm: {unstaged}")
+                staged = _storm_arm(root, by_client, mat, True,
+                                    0.0, queue_cap,
+                                    staged=staged_batch, verify_many=vm)
+                log(f"staged arm (depth {staged_batch}): {staged}")
     finally:
+        if vm_close is not None:
+            vm_close()
         for k, v in scrubbed.items():
             if v is not None:
                 os.environ[k] = v
@@ -1674,15 +1750,25 @@ def measure_broadcaststorm(n_txs: int, n_clients: int = 8) -> dict:
             "admission knobs did not engage")
     if ungated["shed"]:
         raise AssertionError("ungated arm shed — knob leakage")
-    return {
+    out = {
         "gated": gated,
         "ungated_baseline": ungated,
         "overload_x": round(offered_rate / drain_rate, 2),
         "queue_cap": queue_cap,
         "clients": n_clients,
         "txs": n_clients * per_client,
-        "consistency": "admitted==committed exactly once, both arms",
+        "requested_txs": requested_txs,
+        "storm_verifier": storm_verifier,
+        "consistency": "admitted==committed exactly once, all arms",
     }
+    if staged is not None:
+        out["staged"] = staged
+        out["unstaged_baseline"] = unstaged
+        out["staged_batch"] = staged_batch
+        out["staged_vs_unstaged"] = round(
+            staged["sustained_tx_per_sec"]
+            / max(unstaged["sustained_tx_per_sec"], 1e-9), 3)
+    return out
 
 
 def run_worker(args) -> int:
@@ -1817,13 +1903,34 @@ def _worker_metric(args) -> int:
         return 0
     if args.metric == "broadcaststorm":
         # host-only (no device): the admission A/B under a 4x-overload
-        # burst; batch capped so the un-gated arm's drain tail stays
-        # inside the worker budget even on the wheel-less EC fallback
-        extras = measure_broadcaststorm(min(args.batch, 512))
+        # burst plus the staged-vs-unstaged ingress A/B.  The batch is
+        # honored as requested up to a LOUD drain-tail wall-time cap
+        # (the old silent min(batch, 512) hid that the requested scale
+        # never ran); any cap is logged and recorded in the extras
+        storm_cap = 4096
+        n_storm = min(args.batch, storm_cap)
+        if n_storm < args.batch:
+            log(f"broadcaststorm: capping txs {args.batch} -> "
+                f"{n_storm} (un-gated drain tail must fit the worker "
+                f"budget)")
+        n_clients = max(2, args.clients) if args.clients is not None \
+            else 8
+        staged_batch = args.staged_batch if args.staged_batch \
+            is not None else 64
+        extras = measure_broadcaststorm(n_storm, n_clients,
+                                        staged_batch,
+                                        args.storm_verifier)
+        if n_storm < args.batch:
+            extras["batch_capped"] = {"requested": args.batch,
+                                      "ran": n_storm,
+                                      "cap": storm_cap}
         g = extras["gated"]
         u = extras["ungated_baseline"]
         out = {
-            "metric": "broadcaststorm_sustained_tx_per_sec",
+            # the client count rides the metric name (like gossip's
+            # peer count): rates only ever compare like-for-like
+            "metric": f"broadcaststorm_sustained_tx_per_sec_"
+                      f"{n_clients}client",
             "value": g["sustained_tx_per_sec"],
             "unit": "tx/s",
             # ~1.0 = shedding lost no committed throughput while the
@@ -2132,6 +2239,14 @@ def supervise(args, argv) -> int:
                 cpu_argv += ["--peers", str(args.peers)]
         if args.metric == "gossip" and args.peers is not None:
             cpu_argv += ["--peers", str(args.peers)]
+        if args.metric == "broadcaststorm":
+            if args.clients is not None:
+                cpu_argv += ["--clients", str(args.clients)]
+            if args.staged_batch is not None:
+                cpu_argv += ["--staged-batch", str(args.staged_batch)]
+            # sw on the emergency fallback: the device arms would pay
+            # multi-minute CPU XLA compiles out of a burned budget
+            cpu_argv += ["--storm-verifier", "sw"]
         if args.metric == "soak":
             # replayability: the fallback must run the SAME schedule
             if args.soak_seed is not None:
@@ -2206,6 +2321,20 @@ def main() -> int:
                     help="gossip: storm peer count (default 50; the "
                          "metric name carries it); multichannel: the "
                          "top of the rider-peer axis (default 16)")
+    ap.add_argument("--clients", type=int, default=None,
+                    help="broadcaststorm: client thread count "
+                         "(default 8; the metric name carries it)")
+    ap.add_argument("--staged-batch", type=int, default=None,
+                    help="broadcaststorm: staged-arm coalescing depth "
+                         "(FABRIC_MOD_TPU_STAGED_BROADCAST; default "
+                         "64, 0 skips the staged arm)")
+    ap.add_argument("--storm-verifier", choices=("sw", "device"),
+                    default="sw",
+                    help="broadcaststorm: Writers batch verifier the "
+                         "arms dispatch through — sw (host ECDSA, "
+                         "flat per-item cost) or device (ops/p256 "
+                         "batch verify: real batch economics, buckets "
+                         "pre-warmed outside the timed windows)")
     ap.add_argument("--slices", type=int, default=4,
                     help="multichannel: top of the mesh-slice axis "
                          "(the sweep runs 1, slices/2, slices)")
@@ -2259,6 +2388,12 @@ def main() -> int:
             argv += ["--policyeval-verifier", args.policyeval_verifier]
         if args.peers is not None:
             argv += ["--peers", str(args.peers)]
+        if metric == "broadcaststorm":
+            if args.clients is not None:
+                argv += ["--clients", str(args.clients)]
+            if args.staged_batch is not None:
+                argv += ["--staged-batch", str(args.staged_batch)]
+            argv += ["--storm-verifier", args.storm_verifier]
         if metric == "multichannel":
             argv += ["--slices", str(args.slices),
                      "--channels", str(args.channels),

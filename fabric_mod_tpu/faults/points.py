@@ -32,7 +32,10 @@ DECLARED_POINTS: Set[str] = {
     "gossip.comm.drop",
     "gossip.comm.send",
     "orderer.admission.overload",
+    "orderer.broadcast.stage",
+    "orderer.raft.replicate",
     "orderer.raft.submit",
+    "orderer.wal.sync",
     "sharding.dispatch",
 }
 

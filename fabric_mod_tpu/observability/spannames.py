@@ -18,6 +18,7 @@ from typing import Set
 # Keep sorted; the lint rule cross-checks both directions.
 DECLARED_SPANS: Set[str] = {
     "broadcast.handle",
+    "broadcast.stage",
     "broadcast.submit",
     "der_marshal",
     "device_dispatch",
@@ -28,12 +29,14 @@ DECLARED_SPANS: Set[str] = {
     "policy_device",
     "policy_finish",
     "policy_gather",
+    "raft.replicate",
     "recv",
     "shard.dispatch",
     "unpack",
     "verdict_await",
     "verify.flush",
     "verify.resolve",
+    "wal.sync",
 }
 
 

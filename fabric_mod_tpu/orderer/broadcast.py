@@ -25,6 +25,15 @@ the occupancy/latency overload gate shed normal txs with the typed,
 retryable ResourceExhaustedError (+ retry-after) while config and
 lifecycle traffic always passes.  Unarmed, this path is one None
 check: PR 6 behavior exactly.
+
+Throughput (the staged half): with FABRIC_MOD_TPU_STAGED_BROADCAST
+armed, concurrent submitters' normal-tx Writers-policy verifies
+coalesce through the per-channel staging lanes of
+orderer/stagedbroadcast.py — one batched `verify_many` dispatch per
+drain instead of one per submission.  The verdict, `chain.order`, the
+NotLeaderError retrier, and admission's note_latency all stay on the
+SUBMITTER's thread, so typed errors and the overload gate's EWMA stay
+per-envelope.  Config txs always take the blocking path.
 """
 from __future__ import annotations
 
@@ -37,6 +46,8 @@ from fabric_mod_tpu.orderer import admission as admission_mod
 from fabric_mod_tpu.orderer.consensus import NotLeaderError
 from fabric_mod_tpu.orderer.msgprocessor import MsgRejectedError
 from fabric_mod_tpu.orderer.registrar import Registrar
+from fabric_mod_tpu.orderer.stagedbroadcast import (
+    StagedIngress, staged_batch)
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.utils import knobs
 from fabric_mod_tpu.utils.retry import Retrier
@@ -80,6 +91,15 @@ class Broadcast:
         if admission is None:
             admission = admission_mod.AdmissionController.from_env()
         self._admission = admission
+        depth = staged_batch()
+        self._staged: Optional[StagedIngress] = \
+            StagedIngress(depth) if depth > 0 else None
+
+    def close(self) -> None:
+        """Stop the staging lanes (no-op unstaged); racing submitters
+        resolve typed, never hang."""
+        if self._staged is not None:
+            self._staged.close()
 
     def submit(self, env: m.Envelope) -> None:
         """Accept one envelope for ordering; raises BroadcastError on
@@ -125,7 +145,13 @@ class Broadcast:
                 raise BroadcastError(f"config update rejected: {e}") from e
         else:
             try:
-                seq = support.processor.process_normal_msg(env)
+                if self._staged is not None:
+                    # coalesced Writers verify; verdict is still OURS —
+                    # order/retry/latency stay on this thread
+                    seq = self._staged.submit(
+                        support.channel_id, support.processor, env)
+                else:
+                    seq = support.processor.process_normal_msg(env)
             except _CLIENT_FAULTS as e:
                 raise BroadcastError(f"rejected: {e}") from e
             self._retrier.call(support.chain.order, env, seq)

@@ -36,7 +36,9 @@ import threading
 import time
 import zlib
 from typing import Callable, Dict, List, Optional, Tuple
+from fabric_mod_tpu import faults
 from fabric_mod_tpu.concurrency.threads import RegisteredThread
+from fabric_mod_tpu.observability import tracing
 from fabric_mod_tpu.observability.logging import get_logger
 from fabric_mod_tpu.concurrency.locks import RegisteredLock
 
@@ -146,9 +148,22 @@ class RaftWAL:
     of entries BEHIND snap_index (SnapshotCatchUpEntries) so slightly
     lagging followers are repaired by AppendEntries, not snapshots —
     hence the separate log base: entries[i] holds raft index
-    base + i + 1, with base ≤ snap_index ≤ last_index."""
+    base + i + 1, with base ≤ snap_index ≤ last_index.
+
+    Group commit (FABRIC_MOD_TPU_WAL_GROUP_COMMIT): `append` writes
+    the frame buffered and defers the fsync to the next `sync()`
+    barrier — one physical fsync covers every entry appended since the
+    last barrier (all frames share one handle).  The node places the
+    barrier at every durability-before-ack point (before a follower's
+    AppendReply, before the leader counts itself in the quorum), so
+    the crash contract is unchanged: a torn/unsynced tail was never
+    acked, CRC replay crops it, and AppendEntries repair refills it.
+    Unarmed, `append` syncs inline — the pre-PR-16 fsync-per-entry
+    behavior.  `sync_count` counts PHYSICAL fsyncs in both modes (the
+    kill-harness asserts the N→O(1) collapse against it)."""
 
     def __init__(self, path: str):
+        from fabric_mod_tpu.utils import knobs
         self._path = path
         self.term = 0
         self.voted_for: Optional[str] = None
@@ -158,6 +173,10 @@ class RaftWAL:
         self.base = 0            # index of the entry before entries[0]
         self.base_term = 0
         self.entries: List[Tuple[int, bytes]] = []
+        self._group = bool(
+            knobs.get_bool("FABRIC_MOD_TPU_WAL_GROUP_COMMIT"))
+        self._dirty = False
+        self.sync_count = 0
         if os.path.exists(path):
             self._replay()
         self._f = open(path, "ab")
@@ -227,6 +246,23 @@ class RaftWAL:
         return self.entries[s:s + limit]
 
     # -- writes -----------------------------------------------------------
+    def sync(self) -> None:
+        """The group-commit barrier: flush + ONE fsync makes every
+        frame written since the last barrier durable.  A no-op when
+        nothing is pending, so heartbeat-path callers cost nothing.
+        In drop mode the `orderer.wal.sync` fault point swallows the
+        physical fsync — the injected lost-durability window the
+        torn-tail tests crash into."""
+        if not self._dirty:
+            return
+        with tracing.span("wal.sync"):
+            if faults.point("orderer.wal.sync"):
+                return
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self.sync_count += 1
+            self._dirty = False
+
     def save_hardstate(self, term: int, voted_for: Optional[str]) -> None:
         self.term = term
         self.voted_for = voted_for
@@ -234,11 +270,15 @@ class RaftWAL:
         payload = (bytes([_HARDSTATE]) + struct.pack("<q", term)
                    + struct.pack("<I", len(v)) + v)
         self._f.write(self._frame(payload))
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        # term/vote must be durable BEFORE any message acts on them
+        # (§5.1 election safety) — never deferred, in either mode; the
+        # one fsync also covers any entries buffered before it
+        self._dirty = True
+        self.sync()
 
     def append(self, index: int, term: int, data: bytes) -> None:
-        """Write entry at 1-based `index`, truncating conflicts."""
+        """Write entry at 1-based `index`, truncating conflicts.
+        Group mode defers the fsync to the caller's `sync()` barrier."""
         local = index - self.base
         if local < 1:
             return                         # already folded into snapshot
@@ -247,8 +287,9 @@ class RaftWAL:
         payload = (bytes([_ENTRY]) + struct.pack("<qq", term, index)
                    + data)
         self._f.write(self._frame(payload))
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        self._dirty = True
+        if not self._group:
+            self.sync()
 
     def _rewrite(self, snap_index: int, snap_term: int, snap_data: bytes,
                  base: int, base_term: int,
@@ -275,6 +316,7 @@ class RaftWAL:
         self._f.close()
         os.replace(tmp, self._path)
         self._f = open(self._path, "ab")
+        self._dirty = False       # the rewrite fsynced everything kept
         self.snap_index = snap_index
         self.snap_term = snap_term
         self.snap_data = snap_data
@@ -299,6 +341,7 @@ class RaftWAL:
         self._rewrite(index, term, data, index, term, [])
 
     def close(self) -> None:
+        self.sync()               # graceful stop loses nothing buffered
         self._f.close()
 
 
@@ -350,6 +393,19 @@ class RaftNode:
         self._next_index: Dict[str, int] = {}
         self._match_index: Dict[str, int] = {}
         self._snap_sent: Dict[str, float] = {}
+        # optimistic pipelining (FABRIC_MOD_TPU_RAFT_PIPELINE = window
+        # depth): _opt_next[p] tracks the first index NOT yet sent to
+        # p (≥ the acked _next_index); the propose path pushes up to
+        # depth × MAX_ENTRIES_PER_APPEND un-acked entries ahead of the
+        # acks instead of one window per reply round-trip.  Replies
+        # repair it: success advances it, failure resets it to the
+        # repaired _next_index (the classic decrement/hint semantics
+        # untouched).  Safe under any FIFO-per-sender transport — the
+        # in-process RaftTransport delivers synchronously in order
+        from fabric_mod_tpu.utils import knobs as _knobs
+        self._pipeline = max(
+            0, _knobs.get_int("FABRIC_MOD_TPU_RAFT_PIPELINE"))
+        self._opt_next: Dict[str, int] = {}
         # bounded FSM queue (FABRIC_MOD_TPU_RAFT_QUEUE, 0 = unbounded):
         # a peer flooding Step messages can no longer grow host memory
         # without bound — overflow drops the MESSAGE (raft re-sends;
@@ -434,6 +490,24 @@ class RaftNode:
             return False
         return True
 
+    def propose_many(self, datas: List[bytes]) -> bool:
+        """Leader-only multi-entry proposal: every entry lands in the
+        log in ONE FSM turn — one group-commit barrier, one
+        replication broadcast — or none does (False on not-leader or
+        full queue, same contract as `propose`)."""
+        if self.state != LEADER:
+            return False
+        if not datas:
+            return True
+        try:
+            self._q.put_nowait(("propose_many", list(datas)))
+        except queue.Full:
+            from fabric_mod_tpu.orderer.admission import \
+                chain_drop_counter
+            chain_drop_counter().with_labels("raft_msg").add(1)
+            return False
+        return True
+
     def update_peers(self, node_ids) -> None:
         """Reconfigure the member set (applied on the FSM thread).
         Every replica calls this when the SAME committed config entry
@@ -481,6 +555,8 @@ class RaftNode:
                 self._on_message(item[1], item[2])
             elif kind == "propose":
                 self._on_propose(item[1])
+            elif kind == "propose_many":
+                self._on_propose_many(item[1])
             elif kind == "reconfig":
                 self._on_reconfig(item[1])
             # manual clocks block the queue wait in REAL time while
@@ -538,9 +614,11 @@ class RaftNode:
             self._next_index = {p: self.last_index + 1
                                 for p in self.peers}
             self._match_index = {p: 0 for p in self.peers}
+            self._opt_next = dict(self._next_index)
             # no-op barrier entry: lets the new leader commit prior-term
             # entries per the current-term counting rule
             self._append_local(b"")
+            self._wal.sync()               # durable before self-quorum
             self._advance_commit()         # single-node quorum
             self._broadcast_append()
             self._deadline = self._now() + self._hb
@@ -567,12 +645,26 @@ class RaftNode:
         if self.state != LEADER:
             return
         self._append_local(data)
+        self._wal.sync()                   # durable before self-quorum
         self._advance_commit()             # single-node quorum
-        self._broadcast_append()
+        self._broadcast_append(optimistic=True)
 
-    def _broadcast_append(self) -> None:
+    def _on_propose_many(self, datas: List[bytes]) -> None:
+        self._fsm_owner.guard()
+        if self.state != LEADER:
+            return
+        for data in datas:
+            self._append_local(data)
+        self._wal.sync()                   # ONE barrier for the burst
+        self._advance_commit()
+        self._broadcast_append(optimistic=True)
+
+    def _broadcast_append(self, optimistic: bool = False) -> None:
         for p in self.peers:
-            self._send_append(p)
+            if optimistic and self._pipeline > 0:
+                self._pipeline_append(p)
+            else:
+                self._send_append(p)
 
     MAX_ENTRIES_PER_APPEND = 64            # reference: MaxInflightBlocks
 
@@ -600,6 +692,50 @@ class RaftNode:
         self._transport.send(self.id, peer, AppendEntries(
             self._wal.term, self.id, prev_index, prev_term,
             list(entries), self.commit_index))
+        self._opt_next[peer] = max(self._opt_next.get(peer, 0),
+                                   nxt + len(entries))
+
+    def _pipeline_append(self, peer: str) -> None:
+        """Windowed optimistic sends (FABRIC_MOD_TPU_RAFT_PIPELINE):
+        push the un-sent suffix in MAX_ENTRIES_PER_APPEND chunks, up
+        to `depth` windows beyond the acked `_next_index`, without
+        waiting a reply round-trip per window.  A dropped window
+        (injected at `orderer.raft.replicate`, or a real loss) is
+        repaired by the heartbeat resend from `_next_index` plus the
+        classic failure-reply backoff — the repair path is untouched."""
+        nxt = self._next_index.get(peer, self.last_index + 1)
+        if nxt <= self._wal.base:
+            self._send_append(peer)        # snapshot catch-up path
+            return
+        opt = max(self._opt_next.get(peer, nxt), nxt)
+        limit = min(self.last_index,
+                    nxt - 1 + self._pipeline * self.MAX_ENTRIES_PER_APPEND)
+        sent_any = False
+        while opt <= limit:
+            if not (self._wal.base <= opt - 1 <= self._wal.last_index):
+                break                      # suffix compacted mid-flight
+            entries = self._wal.entries_from(
+                opt, min(self.MAX_ENTRIES_PER_APPEND, limit - opt + 1))
+            if not entries:
+                break
+            with tracing.span("raft.replicate"):
+                if faults.point("orderer.raft.replicate"):
+                    return                 # injected window drop
+                self._transport.send(self.id, peer, AppendEntries(
+                    self._wal.term, self.id, opt - 1,
+                    self._wal.term_at(opt - 1), list(entries),
+                    self.commit_index))
+            opt += len(entries)
+            self._opt_next[peer] = opt
+            sent_any = True
+        if not sent_any:
+            # nothing new in the window: still propagate term/commit
+            # (the empty-append heartbeat the unpipelined path sends)
+            prev = min(opt, self.last_index + 1) - 1
+            if self._wal.base <= prev <= self._wal.last_index:
+                self._transport.send(self.id, peer, AppendEntries(
+                    self._wal.term, self.id, prev,
+                    self._wal.term_at(prev), [], self.commit_index))
 
     # -- message handling --------------------------------------------------
     def _on_message(self, src: str, msg) -> None:
@@ -678,6 +814,10 @@ class RaftNode:
                 if self._wal.term_at(idx) == eterm:
                     continue               # already have it
             self._wal.append(idx, eterm, data)
+        # durability-before-ack: ONE barrier covers the whole message's
+        # entries (group mode) before they count toward any quorum —
+        # the success reply below is the ack the leader commits on
+        self._wal.sync()
         if msg.leader_commit > self.commit_index:
             # §5.3: commit at most up to the last entry THIS message
             # matched/appended — the suffix beyond it is unverified
@@ -700,13 +840,23 @@ class RaftNode:
                 self._match_index.get(msg.follower, 0), msg.match_index)
             self._next_index[msg.follower] = \
                 self._match_index[msg.follower] + 1
+            self._opt_next[msg.follower] = max(
+                self._opt_next.get(msg.follower, 0),
+                self._next_index[msg.follower])
             self._advance_commit()
+            if self._pipeline > 0 and \
+                    self._opt_next[msg.follower] <= self.last_index:
+                # an ack freed window room: keep the pipe full
+                self._pipeline_append(msg.follower)
         else:
             # repair: back off, jumping straight to the follower's
-            # hinted last index when it is further behind (§5.3)
+            # hinted last index when it is further behind (§5.3);
+            # every optimistic send past the mismatch is void — resend
+            # from the repaired index
             cur = self._next_index.get(msg.follower, self.last_index + 1)
             self._next_index[msg.follower] = max(
                 1, min(cur - 1, msg.match_index + 1))
+            self._opt_next[msg.follower] = self._next_index[msg.follower]
             self._send_append(msg.follower)
 
     def _advance_commit(self) -> None:

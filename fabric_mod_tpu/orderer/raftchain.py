@@ -328,6 +328,35 @@ class RaftChain:
             if not self.is_leader:
                 break                      # leadership lost: unwind
             time.sleep(0.005)              # FSM queue full: hold off
+        self._requeue(envs, kind, config_seq)
+
+    def _propose_normal_batches(self, batches: List[List[m.Envelope]],
+                                config_seq: int) -> None:
+        """Leader-side multi-batch proposal: with the raft pipeline
+        armed (FABRIC_MOD_TPU_RAFT_PIPELINE), every batch this
+        submission cut enters the raft log in ONE FSM turn via
+        `propose_many` — one group-commit barrier, one replication
+        broadcast — instead of one propose round per block.  Unarmed
+        (or a single batch), one propose per batch: the prior
+        behavior exactly."""
+        from fabric_mod_tpu.utils import knobs
+        if len(batches) > 1 and \
+                knobs.get_int("FABRIC_MOD_TPU_RAFT_PIPELINE") > 0:
+            datas = [_encode_batch(b, _NORMAL) for b in batches]
+            while not self._halted.is_set():
+                if self._raft.propose_many(datas):
+                    return
+                if not self.is_leader:
+                    break                  # leadership lost: unwind ALL
+                time.sleep(0.005)          # FSM queue full: hold off
+            for batch in batches:
+                self._requeue(batch, _NORMAL, config_seq)
+            return
+        for batch in batches:
+            self._propose_batch(batch, _NORMAL, config_seq)
+
+    def _requeue(self, envs: List[m.Envelope], kind: int,
+                 config_seq: int) -> None:
         subs = [_Submit(env.encode(), kind == _CONFIG, config_seq)
                 for env in envs]
         for i, sub in enumerate(subs):
@@ -438,8 +467,8 @@ class RaftChain:
                     except Exception:
                         continue
                 batches, pending = support.cutter.ordered(env)
-                for batch in batches:
-                    self._propose_batch(batch, _NORMAL, sub.config_seq)
+                if batches:
+                    self._propose_normal_batches(batches, sub.config_seq)
                 if batches:
                     timer_deadline = None
                 if pending and timer_deadline is None:

@@ -1,0 +1,190 @@
+"""Staged broadcast ingress: coalesce concurrent submitters' verifies.
+
+The commit path batches (PR 3 commitpipe, PR 12 fused policy); ingress
+still pays one Writers-policy evaluation — one `verify_many` dispatch —
+per `Broadcast.submit()` call.  Under a many-client storm those
+dispatches are the orderer's cap long before raft or the cutter are.
+
+This module is the ingress analogue of the commit pipeline's batching
+discipline: concurrent submitters deposit their normal-tx envelopes
+into a per-channel staging lane and block on a tagged verdict slot; a
+single drainer thread per lane coalesces everything waiting (up to the
+`FABRIC_MOD_TPU_STAGED_BROADCAST` batch bound), runs the whole cohort
+through `StandardChannelProcessor.process_normal_msgs` — ONE bundle
+read, ONE `verify_many` dispatch through the same batch-verifier seam
+the commit path uses — and fans the typed per-envelope verdicts back.
+Each submitter then continues on its OWN thread: `chain.order`, the
+NotLeaderError retrier, and admission's `note_latency` all stay
+per-envelope, so a mid-batch leadership loss retries/sheds each staged
+envelope individually and the overload gate's EWMA keeps seeing true
+submit-to-verdict latencies (not one per-batch sample).
+
+Config txs never enter a lane — they keep the blocking
+`process_config_update_msg` path, and their sequence semantics are
+unchanged: a config commit bumps the bundle, and any staged normal tx
+validated under the older sequence is re-validated by the cutter/chain
+exactly as in the unstaged path.
+
+Fault injection: `faults.point("orderer.broadcast.stage")` fires per
+drain; a triggered rule (drop OR error mode) downgrades that cohort to
+the per-envelope classic path — an ingress-engine fault costs
+amortization, never a lost or mis-verdicted transaction.  The drain
+runs under the `broadcast.stage` span.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, List
+
+from fabric_mod_tpu import faults
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
+from fabric_mod_tpu.concurrency.queues import GuardedQueue
+from fabric_mod_tpu.concurrency.threads import RegisteredThread
+from fabric_mod_tpu.observability import tracing
+from fabric_mod_tpu.utils import knobs
+
+
+def staged_batch() -> int:
+    """FABRIC_MOD_TPU_STAGED_BROADCAST: max envelopes one drain
+    coalesces into a single batched verify; 0/unset disables."""
+    return max(0, knobs.get_int("FABRIC_MOD_TPU_STAGED_BROADCAST"))
+
+
+class _Pending:
+    """One deposited submission: its envelope + the verdict slot the
+    submitter blocks on."""
+
+    __slots__ = ("env", "processor", "_done", "_seq", "_err")
+
+    def __init__(self, env, processor):
+        self.env = env
+        self.processor = processor
+        self._done = threading.Event()
+        self._seq = None                 # config sequence on acceptance
+        self._err = None                 # typed exception on rejection
+
+    def resolve(self, verdict) -> None:
+        if isinstance(verdict, BaseException):
+            self._err = verdict
+        else:
+            self._seq = verdict
+        self._done.set()
+
+    def wait(self) -> int:
+        self._done.wait()
+        if self._err is not None:
+            raise self._err
+        return self._seq
+
+
+class _Lane:
+    """One channel's staging lane: a bounded deposit queue drained by
+    one coalescing worker thread."""
+
+    def __init__(self, channel_id: str, max_batch: int):
+        self._max = max(1, max_batch)
+        self._q = GuardedQueue(
+            max(64, 2 * self._max),
+            name=f"broadcast.stage.{channel_id}")
+        self._thread = RegisteredThread(
+            target=self._run, name=f"broadcast-stage-{channel_id}",
+            structure="stagedbroadcast")
+        self._thread.start()
+
+    def deposit(self, pending: _Pending) -> None:
+        self._q.put(pending)             # bounded: deposits backpressure
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=10)
+        # a deposit that raced past the sentinel still resolves typed
+        # (the drainer released the consumer side on exit): close can
+        # never leave a submitter blocked forever
+        while True:
+            try:
+                p = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if p is not None:
+                p.resolve(RuntimeError("staged ingress closed"))
+
+    # -- drainer ----------------------------------------------------------
+    def _run(self) -> None:
+        try:
+            while True:
+                head = self._q.get()
+                closing = head is None
+                batch: List[_Pending] = [] if closing else [head]
+                while len(batch) < self._max:
+                    try:
+                        nxt = self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        closing = True   # keep draining: a deposit
+                        continue         # racing close still resolves
+                    batch.append(nxt)
+                if batch:
+                    self._flush(batch)
+                if closing:
+                    return
+        finally:
+            self._q.release_consumer()
+
+    def _flush(self, batch: List[_Pending]) -> None:
+        try:
+            with tracing.span("broadcast.stage"):
+                if faults.point("orderer.broadcast.stage"):
+                    raise RuntimeError("injected stage fault")
+                verdicts = batch[0].processor.process_normal_msgs(
+                    [p.env for p in batch])
+        except Exception:  # noqa: BLE001 -- engine fault (injected or
+            # real): downgrade THIS cohort to the classic per-envelope
+            # path so a staging fault never loses a submission
+            for p in batch:
+                try:
+                    p.resolve(p.processor.process_normal_msg(p.env))
+                except Exception as e:  # noqa: BLE001 -- slot verdict
+                    p.resolve(e)
+            return
+        for p, v in zip(batch, verdicts):
+            p.resolve(v)
+
+
+class StagedIngress:
+    """The per-channel lane registry behind `Broadcast.submit`."""
+
+    def __init__(self, max_batch: int):
+        self._max = max_batch
+        self._mu = RegisteredLock("stagedbroadcast.lanes")
+        self._lanes: Dict[str, _Lane] = {}
+        self._closed = False
+
+    def submit(self, channel_id: str, processor, env) -> int:
+        """Deposit one normal tx and block until its verdict: returns
+        the config sequence it validated under, or raises the typed
+        per-envelope rejection."""
+        pending = _Pending(env, processor)
+        self._lane(channel_id).deposit(pending)
+        return pending.wait()
+
+    def _lane(self, channel_id: str) -> _Lane:
+        with self._mu:
+            if self._closed:
+                raise RuntimeError("staged ingress closed")
+            lane = self._lanes.get(channel_id)
+            if lane is None:
+                lane = _Lane(channel_id, self._max)
+                self._lanes[channel_id] = lane
+            return lane
+
+    def close(self) -> None:
+        with self._mu:
+            if self._closed:
+                return
+            self._closed = True
+            lanes = list(self._lanes.values())
+            self._lanes.clear()
+        for lane in lanes:
+            lane.close()

@@ -83,6 +83,22 @@ def _first_csp_verify(policies):
     return got
 
 
+def batch_verifier(policy, verify_many=None):
+    """Resolve the `verify_many` callable `evaluate_signed_data` would
+    use for this policy object: the given one when set, else the
+    policy's own CSP batch path — so batched callers (the staged
+    broadcast drainer) dispatch exactly the verifier the one-shot
+    path would have."""
+    if verify_many is not None:
+        return verify_many
+    if isinstance(policy, CompiledPolicy):
+        return policy._default_verify
+    if isinstance(policy, ImplicitMetaPolicyObj):
+        return _first_csp_verify(policy._subs)
+    raise PolicyError(
+        f"no batch verifier for policy type {type(policy).__name__}")
+
+
 def _find_csp_verify(policies):
     for p in policies:
         if isinstance(p, CompiledPolicy):

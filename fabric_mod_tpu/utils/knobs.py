@@ -249,6 +249,19 @@ declare("FABRIC_MOD_TPU_SHED_LAT_S", "float", 0.0,
 declare("FABRIC_MOD_TPU_RAFT_QUEUE", "int", 8192,
         "raft FSM ingress queue bound; overflowed peer messages drop "
         "counted; 0 = unbounded")
+declare("FABRIC_MOD_TPU_STAGED_BROADCAST", "int", 0,
+        "staged broadcast ingress: max envelopes a per-channel "
+        "drainer coalesces into ONE batched Writers-policy verify; "
+        "0/unset = per-submission processing (pre-staging behavior)")
+declare("FABRIC_MOD_TPU_RAFT_PIPELINE", "int", 0,
+        "in-flight AppendEntries windows per follower (optimistic "
+        "pipelining; replies repair the window on mismatch); "
+        "0/unset = one outstanding round per follower")
+declare("FABRIC_MOD_TPU_WAL_GROUP_COMMIT", "bool", None,
+        "1 defers the raft WAL fsync to the group-commit barrier "
+        "(one fsync covers every entry appended since the last "
+        "barrier, still BEFORE any ack/commit); unset = fsync per "
+        "append")
 
 # -- retries / gossip -------------------------------------------------------
 declare("FABRIC_MOD_TPU_RETRY_BASE_S", "float", 0.05,

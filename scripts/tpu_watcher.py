@@ -107,6 +107,21 @@ MATRIX = [
     # (gated vs ungated overload burst + consistency gate)
     ("broadcaststorm", ["--metric", "broadcaststorm", "--batch", "512"],
      {}, 900),
+    # the staged-vs-unstaged ingress A/B at three client counts, with
+    # the Writers verifies dispatched through the REAL device batch
+    # verifier (--storm-verifier device): the scale curve for the
+    # staged ingress engine — one coalesced dispatch per drain vs one
+    # per submission — with the PR 7 admission pair still gating each
+    # run.  The client count rides the bench metric name.
+    ("broadcaststorm_staged_4client",
+     ["--metric", "broadcaststorm", "--batch", "256", "--clients", "4",
+      "--staged-batch", "64", "--storm-verifier", "device"], {}, 1500),
+    ("broadcaststorm_staged_8client",
+     ["--metric", "broadcaststorm", "--batch", "256", "--clients", "8",
+      "--staged-batch", "64", "--storm-verifier", "device"], {}, 1500),
+    ("broadcaststorm_staged_16client",
+     ["--metric", "broadcaststorm", "--batch", "256", "--clients", "16",
+      "--staged-batch", "64", "--storm-verifier", "device"], {}, 1500),
     # host-only churn soak: a longer on-hardware schedule (12 events)
     # with the fixed seed — every convergence/exactly-once/leak
     # invariant gates before the sustained mixed tx/s is recorded

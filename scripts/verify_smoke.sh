@@ -109,12 +109,31 @@ JAX_PLATFORMS=cpu python -m pytest -q \
 FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
     -p no:cacheprovider -p no:randomly -m 'not slow' \
     tests/test_sharding.py tests/test_parallel.py
+# 0h. the staged-ingress slice, FMT_RACECHECK=1: the coalescing lane
+#     engine (verdicts identical to the per-envelope path, typed
+#     per-envelope NotLeaderError retry/shed, config-vs-staged
+#     sequence semantics, per-envelope note_latency) and the
+#     group-commit WAL crash contract (torn-tail crop + repair
+#     rejoin, N->O(1) fsync collapse) with every race guard armed;
+#     the raft suite re-runs with all three ISSUE 16 knobs hot so the
+#     pipelined replication path is exercised under the guards too
+FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
+    -p no:cacheprovider -p no:randomly -m 'not slow' \
+    tests/test_stagedbroadcast.py tests/test_wal_groupcommit.py
+FMT_RACECHECK=1 JAX_PLATFORMS=cpu \
+    FABRIC_MOD_TPU_WAL_GROUP_COMMIT=1 FABRIC_MOD_TPU_RAFT_PIPELINE=4 \
+    python -m pytest -q \
+    -p no:cacheprovider -p no:randomly -m 'not slow' \
+    tests/test_raft.py tests/test_raft_fakeclock.py
 # CPU XLA compiles of the verify cores run multiple minutes each (the
 # persistent compile cache is TPU-oriented); give the worker room.
 export FABRIC_MOD_TPU_BENCH_TIMEOUT="${FABRIC_MOD_TPU_BENCH_TIMEOUT:-2400}"
 # broadcaststorm: the ingress admission A/B (gated vs ungated 4x
 # overload burst, consistency gate: zero admitted-then-lost, sheds
-# typed) — host-only, small N, bounded wall time
+# typed) — host-only, small N, bounded wall time; --staged-batch adds
+# the unthrottled staged-vs-unstaged pair on the sw verifier (the
+# correctness/consistency gate of the staged engine at smoke scale —
+# the batch-ECONOMICS curve is the watcher's device-verifier job)
 # commitpipe runs TENSOR-ARMED (--tensor-policy 1): its gates then
 # include the tensor-vs-closure txflags + state-fingerprint identity
 # on top of the pipelined/sync/traced differentials; policyeval is
@@ -127,5 +146,5 @@ exec python bench.py --cpu --batch "${SMOKE_BATCH:-64}" --reps 1 \
     --metric diffverify --metric hashverify \
     --metric commitpipe --commitpipe-verifier sw --tensor-policy 1 \
     --metric policyeval --policyeval-verifier sw \
-    --metric broadcaststorm \
+    --metric broadcaststorm --clients 4 --staged-batch 32 \
     --metric multichannel --multichannel-verifier sw --peers 8

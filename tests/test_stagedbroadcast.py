@@ -1,0 +1,481 @@
+"""Staged broadcast ingress (ISSUE 16 tentpole, ingress layer).
+
+Ordering guarantees under staging: the coalesced Writers verify must
+be verdict-identical to the per-envelope path, config updates
+interleaved with staged normal txs keep their sequence semantics, a
+mid-batch NotLeaderError is retried/shed per ENVELOPE (typed), an
+injected stage fault downgrades the cohort instead of losing it, and
+admission's note_latency keeps one submit-to-verdict sample per
+accepted envelope (not one per batch) — the overload gate's EWMA
+must never see batch-amortized latencies.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from fabric_mod_tpu import faults
+from fabric_mod_tpu.orderer import Broadcast
+from fabric_mod_tpu.orderer.broadcast import BroadcastError
+from fabric_mod_tpu.orderer.consensus import NotLeaderError
+from fabric_mod_tpu.orderer.msgprocessor import MsgRejectedError
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+
+CHAN = "sbchan"
+
+
+def _world(root, n_clients=4, max_message_count=4,
+           batch_timeout="50ms", verify_many=None):
+    """One org + one solo orderer over the REAL ingress; returns the
+    CAs too (the config-update test needs an orderer admin)."""
+    from fabric_mod_tpu.bccsp.sw import SwCSP
+    from fabric_mod_tpu.channelconfig import genesis
+    from fabric_mod_tpu.msp import ca as calib
+    from fabric_mod_tpu.msp.identities import SigningIdentity
+    from fabric_mod_tpu.orderer import Registrar
+
+    csp = SwCSP()
+    org_ca = calib.CA("ca.org1", "Org1")
+    ord_ca = calib.CA("ca.orderer", "OrdererOrg")
+    ocert, okey = ord_ca.issue("orderer0", "OrdererOrg",
+                               ous=["orderer"])
+    signer = SigningIdentity("OrdererOrg", ocert, calib.key_pem(okey),
+                             csp)
+    clients = []
+    for i in range(n_clients):
+        cert, key = org_ca.issue(f"client{i}@org1", "Org1",
+                                 ous=["client"])
+        clients.append(SigningIdentity("Org1", cert,
+                                       calib.key_pem(key), csp))
+    gblock = genesis.standard_network(
+        CHAN, {"Org1": [calib.cert_pem(org_ca.cert)]},
+        {"OrdererOrg": [calib.cert_pem(ord_ca.cert)]},
+        max_message_count=max_message_count,
+        batch_timeout=batch_timeout)
+    registrar = Registrar(str(root), signer, csp,
+                          verify_many=verify_many)
+    support = registrar.create_channel(gblock)
+    return {"csp": csp, "org_ca": org_ca, "ord_ca": ord_ca,
+            "clients": clients, "registrar": registrar,
+            "support": support}
+
+
+def _env(signer, tx_id, channel=CHAN):
+    ch = protoutil.make_channel_header(
+        m.HeaderType.ENDORSER_TRANSACTION, channel, tx_id=tx_id)
+    sh = protoutil.make_signature_header(signer.serialize(),
+                                         protoutil.new_nonce())
+    payload = protoutil.make_payload(ch, sh, b"sb-" + tx_id.encode())
+    return protoutil.sign_envelope(payload, signer)
+
+
+def _tampered(signer, tx_id):
+    env = _env(signer, tx_id)
+    bad = bytearray(env.signature)
+    bad[-1] ^= 0x01
+    return m.Envelope(payload=env.payload, signature=bytes(bad))
+
+
+def _committed_tx_ids(store):
+    tx_ids = []
+    for n in range(1, store.height):
+        for env in protoutil.get_envelopes(store.get_block_by_number(n)):
+            ch = protoutil.envelope_channel_header(env)
+            tx_ids.append(ch.tx_id)
+    return tx_ids
+
+
+def _wait_committed(store, want, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if len(_committed_tx_ids(store)) >= want:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the batched processor: verdicts identical to the per-envelope path
+# ---------------------------------------------------------------------------
+
+
+def test_process_normal_msgs_mixed_slots(tmp_path):
+    w = _world(tmp_path, n_clients=2)
+    try:
+        proc = w["support"].processor
+        good0 = _env(w["clients"][0], "ok0")
+        good1 = _env(w["clients"][1], "ok1")
+        wrong_chan = _env(w["clients"][0], "wc", channel="otherchan")
+        forged = _tampered(w["clients"][0], "forged")
+        empty = m.Envelope(payload=b"", signature=b"x")
+        batch = [good0, wrong_chan, good1, empty, forged]
+        results = proc.process_normal_msgs(batch)
+        assert results[0] == proc.process_normal_msg(good0)
+        assert results[2] == proc.process_normal_msg(good1)
+        for bad_slot, bad_env in ((1, wrong_chan), (3, empty),
+                                  (4, forged)):
+            assert isinstance(results[bad_slot], Exception)
+            with pytest.raises(Exception) as ei:
+                proc.process_normal_msg(bad_env)
+            # same verdict TYPE as the one-shot path for this slot
+            assert isinstance(results[bad_slot], type(ei.value)) or \
+                isinstance(ei.value, type(results[bad_slot]))
+        assert isinstance(results[4], MsgRejectedError)
+    finally:
+        w["registrar"].close()
+
+
+def test_process_normal_msgs_batch_failure_falls_back(tmp_path):
+    """A batch-LEVEL verifier failure (device error, not a verdict)
+    degrades to the per-envelope path: no slot inherits a neighbor's
+    infrastructure failure."""
+    calls = {"n": 0}
+
+    def flaky_vm(items):
+        calls["n"] += 1
+        if len(items) > 1:
+            raise RuntimeError("injected batch-verifier outage")
+        from fabric_mod_tpu.bccsp.sw import SwCSP
+        return SwCSP().verify_batch(items)
+
+    w = _world(tmp_path, n_clients=2, verify_many=flaky_vm)
+    try:
+        proc = w["support"].processor
+        envs = [_env(w["clients"][i % 2], f"fb{i}") for i in range(4)]
+        results = proc.process_normal_msgs(envs)
+        assert all(isinstance(r, int) for r in results), results
+        assert calls["n"] >= 5       # 1 failed batch + 4 singles
+    finally:
+        w["registrar"].close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end staging: exactly-once, typed rejections, close semantics
+# ---------------------------------------------------------------------------
+
+
+def test_staged_concurrent_submitters_commit_exactly_once(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("FABRIC_MOD_TPU_STAGED_BROADCAST", "8")
+    w = _world(tmp_path, n_clients=4)
+    bcast = Broadcast(w["registrar"])
+    try:
+        per_client = 6
+        errors = []
+
+        def client_main(ci):
+            for j in range(per_client):
+                try:
+                    bcast.submit(_env(w["clients"][ci], f"c{ci}-{j}"))
+                except Exception as e:  # noqa: BLE001 — gate fails below
+                    errors.append((ci, j, repr(e)))
+
+        threads = [threading.Thread(target=client_main, args=(ci,))
+                   for ci in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert _wait_committed(w["support"].store, 4 * per_client)
+        committed = _committed_tx_ids(w["support"].store)
+        assert sorted(committed) == sorted(
+            f"c{ci}-{j}" for ci in range(4) for j in range(per_client))
+    finally:
+        bcast.close()
+        w["registrar"].close()
+
+
+def test_staged_rejections_typed_per_envelope(tmp_path, monkeypatch):
+    """Forged and valid envelopes interleaved through one lane: each
+    submitter gets ITS verdict — the forged ones a typed
+    BroadcastError, the valid ones a commit."""
+    monkeypatch.setenv("FABRIC_MOD_TPU_STAGED_BROADCAST", "8")
+    w = _world(tmp_path, n_clients=4)
+    bcast = Broadcast(w["registrar"])
+    try:
+        outcomes = {}
+
+        def one(tag, env):
+            try:
+                bcast.submit(env)
+                outcomes[tag] = "ok"
+            except BroadcastError:
+                outcomes[tag] = "rejected"
+
+        threads = []
+        for i in range(8):
+            signer = w["clients"][i % 4]
+            env = _tampered(signer, f"bad{i}") if i % 2 else \
+                _env(signer, f"good{i}")
+            tag = f"bad{i}" if i % 2 else f"good{i}"
+            threads.append(threading.Thread(target=one,
+                                            args=(tag, env)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(outcomes[f"good{i}"] == "ok"
+                   for i in range(0, 8, 2)), outcomes
+        assert all(outcomes[f"bad{i}"] == "rejected"
+                   for i in range(1, 8, 2)), outcomes
+        assert _wait_committed(w["support"].store, 4)
+        assert sorted(_committed_tx_ids(w["support"].store)) == \
+            [f"good{i}" for i in range(0, 8, 2)]
+    finally:
+        bcast.close()
+        w["registrar"].close()
+
+
+def test_staged_close_is_typed_never_hangs(tmp_path, monkeypatch):
+    monkeypatch.setenv("FABRIC_MOD_TPU_STAGED_BROADCAST", "8")
+    w = _world(tmp_path)
+    bcast = Broadcast(w["registrar"])
+    try:
+        bcast.submit(_env(w["clients"][0], "pre-close"))
+        bcast.close()
+        bcast.close()                # idempotent
+        with pytest.raises(RuntimeError, match="staged ingress closed"):
+            bcast.submit(_env(w["clients"][0], "post-close"))
+    finally:
+        bcast.close()
+        w["registrar"].close()
+
+
+def test_stage_fault_downgrades_cohort_not_loses_it(tmp_path,
+                                                    monkeypatch):
+    """orderer.broadcast.stage in drop mode: the drained cohort falls
+    back to the classic per-envelope path — a staging-engine fault
+    costs amortization, never a transaction."""
+    monkeypatch.setenv("FABRIC_MOD_TPU_STAGED_BROADCAST", "8")
+    w = _world(tmp_path, n_clients=4)
+    bcast = Broadcast(w["registrar"])
+    try:
+        plan = faults.FaultPlan().add("orderer.broadcast.stage",
+                                      mode="drop", times=2)
+        with faults.active(plan):
+            threads = [
+                threading.Thread(
+                    target=bcast.submit,
+                    args=(_env(w["clients"][i % 4], f"ft{i}"),))
+                for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert plan.fires("orderer.broadcast.stage") >= 1
+        assert _wait_committed(w["support"].store, 8)
+        assert sorted(_committed_tx_ids(w["support"].store)) == \
+            sorted(f"ft{i}" for i in range(8))
+    finally:
+        bcast.close()
+        w["registrar"].close()
+
+
+# ---------------------------------------------------------------------------
+# NotLeaderError mid-batch: per-envelope retry / typed shed
+# ---------------------------------------------------------------------------
+
+
+def test_notleader_mid_batch_retried_per_envelope(tmp_path,
+                                                  monkeypatch):
+    monkeypatch.setenv("FABRIC_MOD_TPU_STAGED_BROADCAST", "8")
+    monkeypatch.setenv("FABRIC_MOD_TPU_BROADCAST_RETRY_S", "10")
+    w = _world(tmp_path, n_clients=4)
+    support = w["support"]
+    orig_order = support.chain.order
+    seen, mu = set(), threading.Lock()
+
+    def flaky_order(env, seq):
+        tx = protoutil.envelope_channel_header(env).tx_id
+        with mu:
+            first = tx not in seen
+            seen.add(tx)
+        if first:
+            raise NotLeaderError("election in flight")
+        return orig_order(env, seq)
+
+    support.chain.order = flaky_order
+    bcast = Broadcast(w["registrar"])
+    try:
+        threads = [
+            threading.Thread(
+                target=bcast.submit,
+                args=(_env(w["clients"][i % 4], f"nl{i}"),))
+            for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # EVERY envelope hit its own NotLeaderError and was retried
+        # individually on its submitter's thread
+        assert len(seen) == 8
+        assert _wait_committed(support.store, 8)
+        assert sorted(_committed_tx_ids(support.store)) == \
+            sorted(f"nl{i}" for i in range(8))
+    finally:
+        bcast.close()
+        w["registrar"].close()
+
+
+def test_notleader_exhausted_sheds_typed_per_envelope(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv("FABRIC_MOD_TPU_STAGED_BROADCAST", "8")
+    monkeypatch.setenv("FABRIC_MOD_TPU_BROADCAST_RETRY_S", "0")
+    w = _world(tmp_path, n_clients=4)
+    w["support"].chain.order = \
+        lambda env, seq: (_ for _ in ()).throw(
+            NotLeaderError("leaderless", leader_hint="o2"))
+    bcast = Broadcast(w["registrar"])
+    try:
+        hints = []
+
+        def one(i):
+            try:
+                bcast.submit(_env(w["clients"][i % 4], f"sh{i}"))
+            except NotLeaderError as e:
+                hints.append(e.leader_hint)
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # all six submitters got the TYPED error with the hint intact
+        assert hints == ["o2"] * 6
+    finally:
+        bcast.close()
+        w["registrar"].close()
+
+
+# ---------------------------------------------------------------------------
+# config updates concurrent with staged normals: sequence semantics
+# ---------------------------------------------------------------------------
+
+
+def _config_update_env(w, max_message_count):
+    from fabric_mod_tpu.channelconfig import (compute_update,
+                                              signed_update_envelope)
+    from fabric_mod_tpu.channelconfig.bundle import (
+        BATCH_SIZE, ORDERER, groups_of, set_group, set_value, values_of)
+    from fabric_mod_tpu.msp import ca as calib
+    from fabric_mod_tpu.msp.identities import SigningIdentity
+
+    cur = w["support"].bundle().config
+    desired = m.ConfigGroup.decode(cur.channel_group.encode())
+    osec = groups_of(desired)[ORDERER]
+    bs = values_of(osec)[BATCH_SIZE]
+    bs.value = m.BatchSize(
+        max_message_count=max_message_count,
+        absolute_max_bytes=10 * 1024 * 1024,
+        preferred_max_bytes=2 * 1024 * 1024).encode()
+    set_value(osec, BATCH_SIZE, bs)
+    set_group(desired, ORDERER, osec)
+    update = compute_update(CHAN, cur, desired)
+    ocert, okey = w["ord_ca"].issue("admin@orderer", "OrdererOrg",
+                                    ous=["admin"])
+    oadmin = SigningIdentity("OrdererOrg", ocert, calib.key_pem(okey),
+                             w["csp"])
+    return signed_update_envelope(CHAN, update, [oadmin])
+
+
+def test_config_update_concurrent_with_staged_normals(tmp_path,
+                                                      monkeypatch):
+    """A config tx landing mid-storm: it takes the blocking path (never
+    a lane), bumps the bundle sequence, and every staged normal tx —
+    validated under either sequence — still commits exactly once."""
+    monkeypatch.setenv("FABRIC_MOD_TPU_STAGED_BROADCAST", "8")
+    w = _world(tmp_path, n_clients=4, max_message_count=4)
+    bcast = Broadcast(w["registrar"])
+    try:
+        cfg_env = _config_update_env(w, max_message_count=5)
+        errors = []
+        per_client = 8
+
+        def client_main(ci):
+            for j in range(per_client):
+                try:
+                    bcast.submit(_env(w["clients"][ci], f"cc{ci}-{j}"))
+                except Exception as e:  # noqa: BLE001 — gate fails below
+                    errors.append(repr(e))
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=client_main, args=(ci,))
+                   for ci in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.02)             # land the config MID-storm
+        bcast.submit(cfg_env)
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert _wait_committed(w["support"].store, 4 * per_client + 1)
+        # the config committed and bumped the sequence ...
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                w["support"].bundle().sequence == 0:
+            time.sleep(0.02)
+        assert w["support"].bundle().sequence == 1
+        assert w["support"].writer.last_config > 0
+        # ... and every normal tx landed exactly once, config included
+        committed = _committed_tx_ids(w["support"].store)
+        normals = [t for t in committed if t.startswith("cc")]
+        assert sorted(normals) == sorted(
+            f"cc{ci}-{j}" for ci in range(4) for j in range(per_client))
+        assert len(committed) == len(normals) + 1
+    finally:
+        bcast.close()
+        w["registrar"].close()
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: note_latency stays per-envelope under staging
+# ---------------------------------------------------------------------------
+
+
+class _RecordingAdmission:
+    """AdmissionController stand-in: admits everything, records one
+    latency sample per accepted submission."""
+
+    has_limiter = False
+
+    def __init__(self):
+        self.samples = []
+        self._mu = threading.Lock()
+
+    def admit(self, client, priority, occupancy, channel=None):
+        return None
+
+    def note_latency(self, seconds, channel=None):
+        with self._mu:
+            self.samples.append(seconds)
+
+
+def test_note_latency_one_sample_per_envelope_under_staging(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("FABRIC_MOD_TPU_STAGED_BROADCAST", "8")
+    w = _world(tmp_path, n_clients=4)
+    adm = _RecordingAdmission()
+    bcast = Broadcast(w["registrar"], admission=adm)
+    try:
+        n = 16
+        threads = [
+            threading.Thread(
+                target=bcast.submit,
+                args=(_env(w["clients"][i % 4], f"lat{i}"),))
+            for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # one true submit-to-verdict sample per ACCEPTED envelope —
+        # never one per coalesced batch
+        assert len(adm.samples) == n
+        assert all(s > 0 for s in adm.samples)
+    finally:
+        bcast.close()
+        w["registrar"].close()

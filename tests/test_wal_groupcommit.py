@@ -1,0 +1,273 @@
+"""Group-commit WAL durability (ISSUE 16 tentpole, consensus layer).
+
+The contract under FABRIC_MOD_TPU_WAL_GROUP_COMMIT=1: `append` buffers
+frames and the `sync()` barrier makes everything since the last
+barrier durable with ONE physical fsync — always BEFORE any ack or
+commit advance, so the crash contract is byte-identical to the
+fsync-per-entry mode: a tail that was never synced was never acked,
+CRC replay crops it, and AppendEntries repair refills it.
+
+`RaftWAL.sync_count` is the counted hook: it increments once per
+PHYSICAL fsync in both modes, so the N -> O(1) collapse per burst is
+asserted against it, not inferred from timing.
+"""
+import os
+import random
+import threading
+import time
+import zlib
+
+import pytest
+
+from tests._clocksteps import advance_until
+
+from fabric_mod_tpu import faults
+from fabric_mod_tpu.orderer.raft import RaftNode, RaftTransport, RaftWAL
+from fabric_mod_tpu.utils.fakeclock import ManualClock
+
+
+def _wait(pred, timeout=10.0, step=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+def _seeded_rng(i):
+    return random.Random(0x6C01 + zlib.crc32(i.encode()))
+
+
+def _make_cluster(tmp_path, clock, n=3):
+    transport = RaftTransport()
+    ids = [f"n{i}" for i in range(n)]
+    applied = {i: [] for i in ids}
+    nodes = {}
+    for i in ids:
+        nodes[i] = RaftNode(
+            i, ids, transport, str(tmp_path / f"{i}.wal"),
+            lambda idx, data, i=i: applied[i].append((idx, data)),
+            clock=clock, rng=_seeded_rng(i))
+    for node in nodes.values():
+        node.start()
+    return transport, ids, nodes, applied
+
+
+def _leader(nodes, clock):
+    def one_leader():
+        return sum(n.state == "leader" for n in nodes.values()) == 1
+
+    assert advance_until(clock, one_leader), "no single leader elected"
+    return next(n for n in nodes.values() if n.state == "leader")
+
+
+# ---------------------------------------------------------------------------
+# unit: the fsync economics and the crash window
+# ---------------------------------------------------------------------------
+
+
+def test_fsync_per_append_without_knob(tmp_path, monkeypatch):
+    monkeypatch.delenv("FABRIC_MOD_TPU_WAL_GROUP_COMMIT", raising=False)
+    wal = RaftWAL(str(tmp_path / "a.wal"))
+    for i in range(1, 9):
+        wal.append(i, 1, b"d%d" % i)
+    # pre-PR-16 behavior: one physical fsync per appended entry
+    assert wal.sync_count == 8
+    wal.sync()                       # nothing pending: a free barrier
+    assert wal.sync_count == 8
+    wal.close()
+
+
+def test_group_commit_collapses_burst_to_one_fsync(tmp_path,
+                                                   monkeypatch):
+    monkeypatch.setenv("FABRIC_MOD_TPU_WAL_GROUP_COMMIT", "1")
+    wal = RaftWAL(str(tmp_path / "a.wal"))
+    for i in range(1, 33):
+        wal.append(i, 1, b"d%d" % i)
+    assert wal.sync_count == 0       # appends only buffered
+    wal.sync()
+    assert wal.sync_count == 1       # N entries -> ONE fsync
+    wal.sync()                       # clean barrier: no-op
+    assert wal.sync_count == 1
+    wal.close()
+    # close() drains the (empty) buffer; the log survives intact
+    wal2 = RaftWAL(str(tmp_path / "a.wal"))
+    assert [d for _, d in wal2.entries] == [b"d%d" % i
+                                            for i in range(1, 33)]
+    wal2.close()
+
+
+def test_hardstate_always_syncs_in_group_mode(tmp_path, monkeypatch):
+    """Term/vote durability is never deferred (§5.1 election safety):
+    a vote granted from a lost hardstate could elect two leaders."""
+    monkeypatch.setenv("FABRIC_MOD_TPU_WAL_GROUP_COMMIT", "1")
+    wal = RaftWAL(str(tmp_path / "a.wal"))
+    wal.append(1, 1, b"x")           # buffered...
+    wal.save_hardstate(3, "n1")
+    assert wal.sync_count == 1       # ...and the hardstate barrier
+    #                                  covered it in the same fsync
+    wal.close()
+    wal2 = RaftWAL(str(tmp_path / "a.wal"))
+    assert (wal2.term, wal2.voted_for) == (3, "n1")
+    assert wal2.entries == [(1, b"x")]
+    wal2.close()
+
+
+def test_unsynced_tail_cropped_on_replay(tmp_path, monkeypatch):
+    """Crash between the buffered append and the sync barrier: the
+    on-disk file holds the synced prefix plus (at most) a torn suffix
+    of the unsynced frames; replay must recover exactly the prefix."""
+    monkeypatch.setenv("FABRIC_MOD_TPU_WAL_GROUP_COMMIT", "1")
+    path = str(tmp_path / "a.wal")
+    wal = RaftWAL(path)
+    for i in range(1, 5):
+        wal.append(i, 1, b"synced%d" % i)
+    wal.sync()
+    synced_size = os.path.getsize(path)
+    for i in range(5, 9):
+        wal.append(i, 1, b"lost%d" % i)
+    # crash-sim: the frames reached the file object / page cache but
+    # never an fsync — the kernel is allowed to persist any prefix of
+    # them.  Model the worst legal outcome: a torn half-frame.
+    wal._f.flush()
+    full_size = os.path.getsize(path)
+    assert full_size > synced_size
+    wal._f.close()                   # abandon WITHOUT the close() barrier
+    # tear INSIDE the first unsynced frame: everything after the
+    # barrier is non-durable, and a torn frame is the worst legal
+    # survivor
+    with open(path, "r+b") as f:
+        f.truncate(synced_size + 7)
+
+    wal2 = RaftWAL(path)
+    assert [d for _, d in wal2.entries] == [b"synced%d" % i
+                                            for i in range(1, 5)]
+    assert wal2.last_index == 4
+    # the cropped log accepts fresh appends at the recovered tip
+    wal2.append(5, 2, b"refilled")
+    wal2.sync()
+    wal2.close()
+    wal3 = RaftWAL(path)
+    assert wal3.entries[-1] == (2, b"refilled")
+    wal3.close()
+
+
+def test_wal_sync_fault_injects_lost_durability_window(tmp_path,
+                                                       monkeypatch):
+    """Drop-mode `orderer.wal.sync` swallows the physical fsync: the
+    barrier reports clean but the tail is not durable — the injected
+    window the kill-harness crashes into."""
+    monkeypatch.setenv("FABRIC_MOD_TPU_WAL_GROUP_COMMIT", "1")
+    path = str(tmp_path / "a.wal")
+    wal = RaftWAL(path)
+    for i in range(1, 4):
+        wal.append(i, 1, b"keep%d" % i)
+    wal.sync()
+    plan = faults.FaultPlan().add("orderer.wal.sync", mode="drop")
+    with faults.active(plan):
+        for i in range(4, 8):
+            wal.append(i, 1, b"gone%d" % i)
+        wal.sync()                   # swallowed: no flush, no fsync
+        assert plan.fires("orderer.wal.sync") == 1
+    assert wal.sync_count == 1       # only the pre-fault barrier
+    # crash-sim: the dropped barrier left the frames in the
+    # user-space buffer — the on-disk file IS the post-crash state.
+    # Snapshot it before closing the handle (close would flush).
+    disk = open(path, "rb").read()
+    wal._f.close()
+    with open(path, "wb") as f:
+        f.write(disk)
+    wal2 = RaftWAL(path)
+    assert [d for _, d in wal2.entries] == [b"keep%d" % i
+                                            for i in range(1, 4)]
+    wal2.close()
+
+
+# ---------------------------------------------------------------------------
+# cluster: one barrier per burst, crash-repair without double-apply
+# ---------------------------------------------------------------------------
+
+
+def test_propose_many_burst_is_one_barrier_per_node(tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv("FABRIC_MOD_TPU_WAL_GROUP_COMMIT", "1")
+    monkeypatch.setenv("FABRIC_MOD_TPU_RAFT_PIPELINE", "4")
+    clock = ManualClock()
+    transport, ids, nodes, applied = _make_cluster(tmp_path, clock)
+    try:
+        leader = _leader(nodes, clock)
+        followers = [n for n in nodes.values() if n is not leader]
+        # settle the election no-op everywhere before counting fsyncs
+        assert advance_until(clock, lambda: all(
+            n._wal.last_index == leader._wal.last_index
+            for n in followers))
+        s_leader = leader._wal.sync_count
+        s_follow = {n.id: n._wal.sync_count for n in followers}
+        burst = [b"burst%d" % i for i in range(16)]
+        assert leader.propose_many(burst)
+        # replication is message-driven; the final commit-index
+        # propagation to followers rides the (clock-driven) heartbeat
+        assert advance_until(clock, lambda: all(
+            [d for _, d in applied[i]][-16:] == burst for i in ids))
+        # leader: 16 entries appended under ONE barrier
+        assert leader._wal.sync_count - s_leader == 1
+        # followers: one barrier per AppendEntries batch, not per
+        # entry — 16 entries fit one append, so at most a couple of
+        # rounds ever fire
+        for n in followers:
+            assert n._wal.sync_count - s_follow[n.id] <= 2
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_crashed_follower_rejoins_after_torn_tail(tmp_path,
+                                                  monkeypatch):
+    """Kill a follower with an unsynced (torn) WAL tail under group
+    commit: replay crops the tail, the leader's AppendEntries repair
+    refills it, and the follower's post-restart apply stream carries
+    every committed entry exactly once, in order."""
+    monkeypatch.setenv("FABRIC_MOD_TPU_WAL_GROUP_COMMIT", "1")
+    monkeypatch.setenv("FABRIC_MOD_TPU_RAFT_PIPELINE", "2")
+    clock = ManualClock()
+    transport, ids, nodes, applied = _make_cluster(tmp_path, clock)
+    try:
+        leader = _leader(nodes, clock)
+        for i in range(8):
+            assert leader.propose(b"e%d" % i)
+        assert advance_until(
+            clock, lambda: all(len(applied[i]) == 8 for i in ids))
+
+        victim = [i for i in ids if i != leader.id][0]
+        wal_path = str(tmp_path / f"{victim}.wal")
+        nodes[victim].stop()
+        # crash-sim: the node buffered frames it never got to sync —
+        # the file ends in a torn half-frame
+        with open(wal_path, "ab") as f:
+            f.write(b"\x13\x37torn-frame-prefix")
+
+        applied[victim] = []
+        revived = RaftNode(
+            victim, ids, transport, wal_path,
+            lambda idx, data: applied[victim].append((idx, data)),
+            clock=clock, rng=_seeded_rng(victim))
+        # replay cropped the torn tail back to the synced log
+        assert [d for _, d in revived._wal.entries
+                if d] == [b"e%d" % i for i in range(8)]
+        revived.start()
+        nodes[victim] = revived
+        leader2 = _leader(nodes, clock)
+        for i in range(8, 12):
+            assert leader2.propose(b"e%d" % i)
+        assert advance_until(
+            clock, lambda: len(applied[victim]) >= 12)
+        # exactly once, in order: indices strictly ascending, payloads
+        # the full committed sequence (no double-apply, no gap)
+        idxs = [ix for ix, _ in applied[victim]]
+        assert idxs == sorted(set(idxs))
+        datas = [d for _, d in applied[victim] if d]
+        assert datas == [b"e%d" % i for i in range(12)]
+    finally:
+        for n in nodes.values():
+            n.stop()
