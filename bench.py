@@ -1633,6 +1633,276 @@ def measure_soak(seed, n_events) -> dict:
     return rep
 
 
+def _fanout_chain(channel_id: str, n_blocks: int, config_at: int):
+    """Deterministic committed chain for the fan-out A/B: endorser txs
+    with chaincode events (the filtered projection has real work), a
+    multi-action tx per block (exercising the batch scanner's
+    fallback), and one mid-chain CONFIG block (exercising the forced
+    session re-check)."""
+    from fabric_mod_tpu.protos import messages as m
+    from fabric_mod_tpu.protos import protoutil
+
+    def tx_bytes(txid, nactions=1):
+        actions = []
+        for _ in range(nactions):
+            ev = m.ChaincodeEvent(chaincode_id="cc", tx_id=txid,
+                                  event_name="moved",
+                                  payload=b"p" * 64).encode()
+            cca = m.ChaincodeAction(results=b"rw" * 32, events=ev)
+            prp = m.ProposalResponsePayload(proposal_hash=b"h" * 32,
+                                            extension=cca.encode())
+            cap = m.ChaincodeActionPayload(
+                chaincode_proposal_payload=b"cpp",
+                action=m.ChaincodeEndorsedAction(
+                    proposal_response_payload=prp.encode(),
+                    endorsements=[m.Endorsement(endorser=b"e" * 64,
+                                                signature=b"s" * 70)]))
+        actions.append(m.TransactionAction(header=b"sh",
+                                           payload=cap.encode()))
+        return m.Transaction(actions=actions).encode()
+
+    def env(txid, htype=None, data=b""):
+        htype = (m.HeaderType.ENDORSER_TRANSACTION
+                 if htype is None else htype)
+        ch = protoutil.make_channel_header(htype, channel_id, tx_id=txid)
+        sh = protoutil.make_signature_header(b"creator", b"\x00" * 24)
+        payload = protoutil.make_payload(ch, sh, data)
+        return m.Envelope(payload=payload.encode(), signature=b"sig")
+
+    blocks = []
+    for b in range(n_blocks):
+        if b == config_at:
+            envs = [env(f"cfg-{b}", htype=m.HeaderType.CONFIG,
+                        data=b"new-config")]
+        else:
+            envs = [env(f"t{b}-{i}", data=tx_bytes(f"t{b}-{i}"))
+                    for i in range(3)]
+            envs.append(env(f"t{b}-multi",
+                            data=tx_bytes(f"t{b}-multi", nactions=2)))
+        blk = protoutil.new_block(b, b"\x00" * 32, envs)
+        protoutil.set_block_txflags(
+            blk, bytes([m.TxValidationCode.VALID] * len(envs)))
+        blocks.append(blk)
+    return blocks
+
+
+class _RevealLedger:
+    """ledger-shaped replay source: the pre-built chain revealed block
+    by block (the sustained commit traffic), identically for both
+    arms — the determinism the byte-identity gate needs."""
+
+    def __init__(self, blocks):
+        import threading
+        self._blocks = blocks
+        self._revealed = 0
+        self.height_changed = threading.Condition()
+
+    @property
+    def height(self):
+        return self._revealed
+
+    def get_block_by_number(self, num):
+        if 0 <= num < self._revealed:
+            return self._blocks[num]
+        return None
+
+    def reveal(self):
+        self._revealed += 1
+        with self.height_changed:
+            self.height_changed.notify_all()
+
+
+def measure_deliverfanout(n_subscribers: int) -> dict:
+    """Shared fan-out vs per-stream materialization (host-only A/B).
+
+    Per swept subscriber count: the SAME revealed-block-by-block chain
+    drives (a) the shared FanoutEngine with N mixed full/filtered
+    subscribers consuming ring frames over a small worker pool, and
+    (b) the historical per-stream arm (every stream re-projects +
+    re-encodes every block, batch=False) on a bounded sample of
+    streams (the arm's blocks*subs/s is size-invariant — each frame
+    costs a full materialization regardless of N).
+
+    Gates, per point, BEFORE any rate is reported:
+      * byte-identity — every subscriber's frame-sequence digest equals
+        the per-stream arm's digest for its form;
+      * one materialization + one encode per (block, form), zero
+        ring fallbacks;
+      * the batched session ACL fired exactly once per (group, key).
+    """
+    import hashlib
+    import threading as th
+    import time as _t
+
+    from fabric_mod_tpu.peer.fanout import FanoutEngine, encode_frame
+    from fabric_mod_tpu.protos.protoutil import SignedData
+
+    channel_id = "bench-fanout"
+    n_groups = 4
+
+    class _SeqAcl:
+        def __init__(self):
+            self.seq = 0
+            self.checks = 0
+
+        def config_sequence(self):
+            return self.seq
+
+        def check_acl(self, resource, sds):
+            self.checks += 1
+
+    points = sorted({max(8, n_subscribers // 100),
+                     max(32, n_subscribers // 10), n_subscribers})
+    results = []
+    for n_subs in points:
+        n_blocks = max(6, min(24, 200_000 // max(1, n_subs)))
+        config_at = n_blocks // 2
+        blocks = _fanout_chain(channel_id, n_blocks, config_at)
+
+        # reference digests: the per-stream sender's exact output
+        refs = {}
+        for form in ("full", "filtered"):
+            h = hashlib.sha256()
+            for blk in blocks:
+                h.update(encode_frame(channel_id, form, blk,
+                                      batch=False))
+            refs[form] = h.hexdigest()
+
+        # -- shared arm ------------------------------------------------
+        led = _RevealLedger(blocks)
+        acl = _SeqAcl()
+        eng = FanoutEngine(channel_id, led, acl,
+                           ring_size=max(128, n_blocks))
+        forms = ["full" if i % 2 else "filtered"
+                 for i in range(n_subs)]
+        sessions = [eng.acl_groups.join(
+            "event/Block" if forms[i] == "full"
+            else "event/FilteredBlock",
+            SignedData(data=b"d", identity=b"id%d" % (i % n_groups),
+                       signature=b"s"),
+            acl.seq) for i in range(n_subs)]
+        for f in forms:
+            eng.attach(f)
+        digests = [hashlib.sha256() for _ in range(n_subs)]
+        nexts = [0] * n_subs
+        n_workers = min(8, n_subs)
+        slices = [list(range(w, n_subs, n_workers))
+                  for w in range(n_workers)]
+        errors = []
+
+        def run_slice(idx):
+            try:
+                waiter = eng.notifier.waiter()
+                pending = set(slices[idx])
+                while pending:
+                    progress = False
+                    for s in list(pending):
+                        while nexts[s] < n_blocks:
+                            fr = eng.get_frame(forms[s], nexts[s])
+                            if fr is None:
+                                break
+                            if fr.is_config:
+                                sessions[s].recheck(
+                                    force=True, config_mark=fr.num)
+                            else:
+                                sessions[s].recheck()
+                            digests[s].update(fr.payload)
+                            nexts[s] += 1
+                            progress = True
+                        if nexts[s] >= n_blocks:
+                            pending.discard(s)
+                    if pending and not progress:
+                        low = min(nexts[s] for s in pending)
+                        if eng.notifier.wait_above(
+                                low, waiter, timeout_s=30.0) == "timeout":
+                            raise RuntimeError("fanout stall")
+                eng.notifier.release(waiter)
+            except Exception as e:  # worker failure must fail the gate
+                errors.append(e)
+
+        def pace():
+            for b in range(n_blocks):
+                if b == config_at:
+                    acl.seq += 1      # the config commit advances it
+                led.reveal()
+                _t.sleep(0.001)       # sustained traffic, not a batch
+
+        workers = [th.Thread(target=run_slice, args=(w,), daemon=True)
+                   for w in range(n_workers)]
+        t0 = _t.perf_counter()
+        pacer = th.Thread(target=pace, daemon=True)
+        pacer.start()
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join(timeout=600)
+        shared_s = _t.perf_counter() - t0
+        pacer.join(timeout=60)
+        for f in forms:
+            eng.detach(f)
+        eng.close()
+        if errors:
+            raise AssertionError(f"fanout worker failed: {errors[0]}")
+
+        # gate 1: every stream's frame sequence bit-identical to the
+        # per-stream arm's output
+        for i in range(n_subs):
+            assert digests[i].hexdigest() == refs[forms[i]], \
+                f"stream {i} ({forms[i]}) diverged from the " \
+                f"per-stream materialization at {n_subs} subscribers"
+        # gate 2: one materialization + one encode per (block, form),
+        # no slow-path fallbacks
+        for form in ("full", "filtered"):
+            st = eng.stats[form]
+            assert st["materialized"] == n_blocks, st
+            assert st["encoded"] == n_blocks, st
+            assert st["fallbacks"] == 0, st
+        # gate 3: the batched session re-check fired once per (group,
+        # key) — at most two keys exist per config commit (the
+        # standing sequence-advance recheck and the forced config-mark
+        # recheck; which members hit first is timing), so N streams
+        # produce at most 2 evaluations per group, never one per
+        # stream
+        n_group_objs = len(eng.acl_groups._groups)
+        assert n_group_objs <= acl.checks <= 2 * n_group_objs, \
+            (acl.checks, n_group_objs)
+
+        # -- per-stream arm (bounded sample; rate is size-invariant) --
+        sample = min(n_subs, 128)
+        t0 = _t.perf_counter()
+        h_check = [hashlib.sha256() for _ in range(sample)]
+        for i in range(sample):
+            form = forms[i]
+            for blk in blocks:
+                h_check[i].update(encode_frame(channel_id, form, blk,
+                                               batch=False))
+        per_stream_s = _t.perf_counter() - t0
+        for i in range(sample):
+            assert h_check[i].hexdigest() == refs[forms[i]]
+
+        shared_rate = n_blocks * n_subs / shared_s
+        per_rate = n_blocks * sample / per_stream_s
+        log(f"deliverfanout: {n_subs} subs x {n_blocks} blocks — "
+            f"shared {shared_rate:,.0f} vs per-stream "
+            f"{per_rate:,.0f} blocks*subs/s "
+            f"({shared_rate / per_rate:.1f}x, sample {sample})")
+        results.append({
+            "subscribers": n_subs, "blocks": n_blocks,
+            "shared_blocks_subs_per_sec": round(shared_rate, 1),
+            "per_stream_blocks_subs_per_sec": round(per_rate, 1),
+            "per_stream_sample": sample,
+            "identical": True,
+            "acl_group_checks": acl.checks,
+        })
+    top = results[-1]
+    ratio = (top["shared_blocks_subs_per_sec"]
+             / top["per_stream_blocks_subs_per_sec"])
+    assert ratio > 1.0, \
+        f"shared fan-out did not beat per-stream at the top point " \
+        f"({ratio:.2f}x)"
+    return {"points": results, "top": top, "ratio": ratio}
+
+
 def measure_broadcaststorm(n_txs: int, n_clients: int = 8,
                            staged_batch: int = 64,
                            storm_verifier: str = "sw") -> dict:
@@ -1899,6 +2169,21 @@ def _worker_metric(args) -> int:
         }
         if "stage_attribution" in rep:
             out["stage_attribution"] = rep["stage_attribution"]
+        print(json.dumps(out))
+        return 0
+    if args.metric == "deliverfanout":
+        # host-only (no device): the shared fan-out A/B; every rate is
+        # gated by the byte-identity + once-per-(block, form) +
+        # once-per-(group, key) assertions inside the measure
+        extras = measure_deliverfanout(args.subscribers)
+        out = {
+            "metric": "deliverfanout_blocks_subscribers_per_sec",
+            "value": extras["top"]["shared_blocks_subs_per_sec"],
+            "unit": "blocks*subs/s",
+            "vs_baseline": round(extras["ratio"], 3),
+            "subscribers": extras["top"]["subscribers"],
+            "points": extras["points"],
+        }
         print(json.dumps(out))
         return 0
     if args.metric == "broadcaststorm":
@@ -2253,6 +2538,8 @@ def supervise(args, argv) -> int:
                 cpu_argv += ["--soak-seed", str(args.soak_seed)]
             if args.soak_events is not None:
                 cpu_argv += ["--soak-events", str(args.soak_events)]
+        if args.metric == "deliverfanout":
+            cpu_argv += ["--subscribers", str(args.subscribers)]
     result, note = _spawn_worker(cpu_argv, cpu_env, timeout_s)
     log(f"[bench] cpu fallback: {note}")
     if result is not None:
@@ -2280,7 +2567,8 @@ def main() -> int:
                     choices=("verify", "block", "e2e", "idemix", "gossip",
                              "marshal", "diffverify", "hashverify",
                              "commitpipe", "broadcaststorm", "soak",
-                             "policyeval", "multichannel"),
+                             "policyeval", "multichannel",
+                             "deliverfanout"),
                     default=None,
                     help="repeatable: each metric runs in sequence and "
                          "prints its own JSON line (the smoke target "
@@ -2351,6 +2639,9 @@ def main() -> int:
     ap.add_argument("--soak-events", type=int, default=None,
                     help="soak: churn events per run (default "
                          "FMT_SOAK_EVENTS or 6)")
+    ap.add_argument("--subscribers", type=int, default=10000,
+                    help="deliverfanout: top of the subscriber-count "
+                         "sweep (>=3 points up to this)")
     ap.add_argument("--trace-out", default=None,
                     help="run FMT_TRACE-armed and export the span "
                          "ring as Chrome trace-event JSON "
@@ -2404,6 +2695,8 @@ def main() -> int:
                 argv += ["--soak-seed", str(args.soak_seed)]
             if args.soak_events is not None:
                 argv += ["--soak-events", str(args.soak_events)]
+        if metric == "deliverfanout":
+            argv += ["--subscribers", str(args.subscribers)]
         rc |= supervise(args, argv)
     return rc
 

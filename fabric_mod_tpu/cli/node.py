@@ -366,8 +366,9 @@ def run_peer(org: str, genesis_path: str, crypto_dir: str,
     # one listener for every peer-facing service (endorsement + client
     # events), like the reference's single peer gRPC server
     # worker headroom: event streams park threads at the chain tip
-    # (EventDeliverServer caps them at 40), endorsement must always
-    # find a free worker beyond that cap
+    # (EventDeliverServer caps them at FABRIC_MOD_TPU_DELIVER_STREAMS,
+    # default 40), endorsement must always find a free worker beyond
+    # that cap
     pserver = GRPCServer(peer_listen,
                          server_cert_pem=tls.get("server.crt"),
                          server_key_pem=tls.get("server.key"),

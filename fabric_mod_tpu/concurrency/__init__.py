@@ -42,6 +42,7 @@ behavior); with it set, the whole tier-1 suite runs with every guard
 armed and tests/test_racecheck.py's injected-race canaries prove each
 one bites.
 """
+from fabric_mod_tpu.concurrency.cancel import CancellationEvent
 from fabric_mod_tpu.concurrency.core import (RaceError, armed, enable,
                                              enabled)
 from fabric_mod_tpu.concurrency.locks import (LockOrderRegistry,
@@ -56,7 +57,7 @@ from fabric_mod_tpu.concurrency.threads import (RegisteredThread,
                                                 live_registered)
 
 __all__ = [
-    "RaceError", "enabled", "enable", "armed",
+    "RaceError", "enabled", "enable", "armed", "CancellationEvent",
     "OrderedLock", "RegisteredLock", "LockOrderRegistry",
     "lock_registry",
     "GuardedQueue", "OwnedState", "ThreadOwnership",

@@ -1,0 +1,54 @@
+"""CancellationEvent: a threading.Event whose set() also runs hooks.
+
+The deliver paths park streams on a commit condition; a plain
+``threading.Event`` used as the stream's stop signal cannot wake that
+wait, which is why the pre-fanout loops ticked (0.25 s / 1.0 s slices
+per parked stream — ISSUE 17's 10k-wakeups/s problem).  A
+CancellationEvent closes the gap: ``on_set`` registers a wake hook
+(notify a condition, set a waiter's event) that fires exactly when the
+event is set, so a parked stream can wait full-length and still stop
+promptly.
+
+Hooks must be cheap and non-blocking (they run on the canceller's
+thread — a gRPC callback or a client's ``stop()``); exceptions are
+swallowed so one broken hook cannot mask the cancellation itself.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List
+
+
+class CancellationEvent(threading.Event):
+    """An Event with set-time wake hooks (see module docstring)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        # GIL-atomic list ops; hooks snapshot via list() before firing,
+        # so a concurrent unsubscribe never mutates mid-iteration
+        self._hooks: List[Callable[[], None]] = []
+
+    def on_set(self, hook: Callable[[], None]) -> Callable[[], None]:
+        """Register `hook` to run at set() time; fires immediately if
+        already set (the canceller won).  Returns an unsubscribe."""
+        self._hooks.append(hook)
+        if self.is_set():
+            try:
+                hook()
+            except Exception:  # fmtlint: allow[swallowed-exceptions] -- a wake hook must never mask the cancellation
+                pass
+
+        def unsubscribe() -> None:
+            try:
+                self._hooks.remove(hook)
+            except ValueError:
+                pass
+        return unsubscribe
+
+    def set(self) -> None:
+        super().set()
+        for hook in list(self._hooks):
+            try:
+                hook()
+            except Exception:  # fmtlint: allow[swallowed-exceptions] -- a wake hook must never mask the cancellation
+                pass

@@ -28,6 +28,7 @@ DECLARED_POINTS: Set[str] = {
     "commitpipe.commit",
     "commitpipe.stage",
     "deliver.failover.stream",
+    "deliver.fanout",
     "deliver.stream",
     "gossip.comm.drop",
     "gossip.comm.send",

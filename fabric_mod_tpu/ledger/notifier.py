@@ -1,0 +1,186 @@
+"""CommitNotifier: one thread fans a ledger's commit signal out.
+
+(reference: core/peer/gossip + deliver's CommitNotifier role — block
+commit is observed ONCE and every standing deliver stream is handed
+the signal, instead of each stream polling the tip.)
+
+Before this module, every Deliver/DeliverFiltered stream parked inside
+``cond.wait(timeout=1.0)`` on the ledger's ``height_changed``
+condition: 10k parked subscribers generated 10k wakeups per second of
+pure tick traffic.  The CommitNotifier replaces the per-stream tick
+with ONE RegisteredThread parked (untimed) on the source condition;
+when the height advances it first runs the registered on-commit
+callbacks (the fan-out engine materializes the new frames here, so
+frames are ready BEFORE any subscriber wakes) and then sets each
+parked waiter's private Event — one wakeup per (commit, waiter),
+zero wakeups while idle.
+
+Waiters never touch the source condition: a stream waits on its own
+``CommitWaiter`` Event, which a client cancellation (a
+``CancellationEvent`` hook), server close, or the notifier itself can
+set — so stop()/close() latency stays bounded without ticks.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Set
+
+from fabric_mod_tpu.concurrency import RegisteredThread, assert_joined
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
+
+
+class CommitWaiter:
+    """One parked stream's wake handle (see CommitNotifier)."""
+
+    __slots__ = ("event", "cancelled", "wakes")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.cancelled = False
+        self.wakes = 0          # commit signals received (test surface)
+
+    def cancel(self) -> None:
+        """Wake the waiter out of any pending wait (idempotent); the
+        hook side of a stream's CancellationEvent."""
+        self.cancelled = True
+        self.event.set()
+
+
+class CommitNotifier:
+    """Fan one commit condition out to N parked waiters.
+
+    `cond` is the source's commit condition (``notify_all`` on every
+    commit: KvLedger.height_changed / BlockWriter.height_changed) and
+    `height_fn` reads its current height.  Both are safe to call with
+    `cond` held (the committers notify OUTSIDE their store locks).
+    """
+
+    def __init__(self, cond: threading.Condition,
+                 height_fn: Callable[[], int], name: str = "commit"):
+        self._cond = cond
+        self._height = height_fn
+        self._name = name
+        self._lock = RegisteredLock(f"ledger.notifier.{name}._lock")
+        self._waiters: Set[CommitWaiter] = set()
+        self._callbacks: List[Callable[[int], None]] = []
+        self._closed = False
+        self._started = False
+        self._thread: Optional[RegisteredThread] = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def ensure_started(self) -> None:
+        """Start the relay thread on first demand (a server with no
+        parked streams never spawns it)."""
+        with self._lock:
+            if self._started or self._closed:
+                return
+            self._started = True
+            self._thread = RegisteredThread(
+                self._run, name=f"notifier-{self._name}",
+                structure="CommitNotifier")
+            self._thread.start()
+
+    def close(self) -> None:
+        """Stop the relay and wake every parked waiter (idempotent).
+        Bounded: the relay parks untimed but close() notifies the
+        source condition, so the join is prompt."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            thread = self._thread
+            waiters = list(self._waiters)
+        with self._cond:
+            self._cond.notify_all()
+        for w in waiters:
+            w.event.set()
+        if thread is not None:
+            assert_joined([thread], owner=f"CommitNotifier({self._name})")
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- registration ------------------------------------------------------
+
+    def on_commit(self, callback: Callable[[int], None]) -> None:
+        """Run `callback(height)` on the relay thread after each height
+        advance, BEFORE waiters wake (frame materialization hook)."""
+        with self._lock:
+            self._callbacks.append(callback)
+
+    def waiter(self) -> CommitWaiter:
+        self.ensure_started()
+        w = CommitWaiter()
+        with self._lock:
+            self._waiters.add(w)
+            if self._closed:
+                w.event.set()
+        return w
+
+    def release(self, w: CommitWaiter) -> None:
+        with self._lock:
+            self._waiters.discard(w)
+
+    # -- the wait (stream side) -------------------------------------------
+
+    def wait_above(self, num: int, w: CommitWaiter,
+                   timeout_s: Optional[float] = None) -> str:
+        """Park until height > num: "commit", or "cancelled" /
+        "closed" / "timeout".  Safe against lost wakeups: the height
+        is re-read before every wait, and a commit signal arriving
+        between the read and the wait sets the (still-uncleared)
+        event."""
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        while True:
+            if self._height() > num:
+                return "commit"
+            if w.cancelled:
+                return "cancelled"
+            if self._closed:
+                return "closed"
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return "timeout"
+                ok = w.event.wait(timeout=remaining)
+            else:
+                ok = w.event.wait()
+            if ok:
+                w.event.clear()
+
+    # -- the relay (notifier thread) ---------------------------------------
+
+    def _run(self) -> None:
+        cond = self._cond
+        last = self._height()
+        while True:
+            with cond:
+                # reading the height under the source cond is the same
+                # ordering every pre-fanout stream used (commit paths
+                # notify OUTSIDE their store locks, so no inversion)
+                while not self._closed and self._height() == last:
+                    cond.wait()
+                if self._closed:
+                    break
+                h = self._height()
+            last = h
+            with self._lock:
+                callbacks = list(self._callbacks)
+                waiters = list(self._waiters)
+            for cb in callbacks:
+                try:
+                    cb(h)
+                except Exception:  # fmtlint: allow[swallowed-exceptions] -- a materialization hook failure must not kill the relay; streams fall back to ledger re-read
+                    pass
+            for w in waiters:
+                w.wakes += 1
+                w.event.set()
+        # closing: hand every parked waiter the final wake
+        with self._lock:
+            waiters = list(self._waiters)
+        for w in waiters:
+            w.event.set()

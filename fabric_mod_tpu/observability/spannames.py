@@ -22,6 +22,7 @@ DECLARED_SPANS: Set[str] = {
     "broadcast.submit",
     "der_marshal",
     "device_dispatch",
+    "fanout.materialize",
     "fingerprint",
     "gossip.drain",
     "ledger_write",

@@ -15,6 +15,7 @@ import time
 from typing import Iterator, Optional
 
 from fabric_mod_tpu import faults
+from fabric_mod_tpu.concurrency import CancellationEvent
 from fabric_mod_tpu.orderer.registrar import ChainSupport
 from fabric_mod_tpu.protos import messages as m
 
@@ -45,24 +46,34 @@ class DeliverService:
                 yield blk
                 num += 1
                 continue
-            with cond:
-                if store.height > num:
-                    continue              # raced a write; re-read
-                # wait in slices: the writer's cond wakes us on a new
-                # block, but stop_event (the deliver client's stop())
-                # can't notify this cond — an unsliced wait(timeout_s)
-                # would pin a stopped puller (and its commit
-                # pipeline's threads) to the tip for the full idle
-                # timeout (leak found by the FMT_RACECHECK
-                # registered-thread sweep).  0.25 s bounds stop()
-                # latency well inside every join budget without
-                # hammering the writer's condition lock from each
-                # idle stream (commits still wake us instantly)
-                deadline = time.monotonic() + timeout_s
-                while store.height <= num:
-                    if stop_event is not None and stop_event.is_set():
-                        return
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        return            # idle timeout: end the stream
-                    cond.wait(timeout=min(0.25, remaining))
+            # a CancellationEvent can notify the writer's cond on
+            # set(), so those streams park tickless until a commit,
+            # cancel, or the idle deadline; a plain Event (legacy
+            # callers) cannot reach into the cond, so it keeps the
+            # 0.25 s slice that bounds stop() latency inside every
+            # join budget (leak found by the FMT_RACECHECK
+            # registered-thread sweep)
+            unhook = None
+            if isinstance(stop_event, CancellationEvent):
+                def _wake() -> None:
+                    with cond:
+                        cond.notify_all()
+                unhook = stop_event.on_set(_wake)
+            try:
+                with cond:
+                    if store.height > num:
+                        continue          # raced a write; re-read
+                    deadline = time.monotonic() + timeout_s
+                    while store.height <= num:
+                        if stop_event is not None and stop_event.is_set():
+                            return
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            return        # idle timeout: end the stream
+                        if unhook is not None:
+                            cond.wait(timeout=remaining)
+                        else:
+                            cond.wait(timeout=min(0.25, remaining))
+            finally:
+                if unhook is not None:
+                    unhook()

@@ -15,6 +15,7 @@ import threading
 from typing import Iterator, Optional
 
 from fabric_mod_tpu.comm.grpc_comm import GRPCServer, MethodKind
+from fabric_mod_tpu.concurrency import CancellationEvent
 from fabric_mod_tpu.orderer.admission import ResourceExhaustedError
 from fabric_mod_tpu.orderer.broadcast import Broadcast, BroadcastError
 from fabric_mod_tpu.orderer.consensus import NotLeaderError
@@ -115,7 +116,10 @@ class OrdererServer:
             h = support.store.height
             start = protoutil.seek_number(seek.start, h, newest_tip=True)
             stop = protoutil.seek_number(seek.stop, h, newest_tip=False)
-            stop_event = threading.Event()
+            # CancellationEvent: its set() hook notifies the writer's
+            # condition, so a cancelled stream leaves a tickless tip
+            # wait immediately (orderer/deliver.py)
+            stop_event = CancellationEvent()
             cb = context.add_callback(stop_event.set)
             for block in svc.blocks(start, stop=stop,
                                     stop_event=stop_event,

@@ -33,7 +33,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, List, Optional
 
-from fabric_mod_tpu.concurrency import OwnedState
+from fabric_mod_tpu.concurrency import CancellationEvent, OwnedState
 from fabric_mod_tpu.observability import tracing
 from fabric_mod_tpu.peer.channel import Channel
 from fabric_mod_tpu.peer.commitpipe import PipelinedCommitter, pipeline_depth
@@ -83,7 +83,10 @@ class DeliverClient:
         self._channel = channel
         self._source = source
         self._on_commit = on_commit
-        self._stop = threading.Event()
+        # CancellationEvent so an in-process DeliverService tip wait
+        # parks tickless: stop() both flags the loop AND (via the
+        # service's on_set hook) notifies the writer's condition
+        self._stop = CancellationEvent()
         self._depth = depth if depth is not None else \
             (pipeline_depth() or 2)
         self._queue_size = queue_size

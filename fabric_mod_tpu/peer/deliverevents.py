@@ -9,9 +9,12 @@ commit — without it no application can know its tx committed.)
 Server side: seek semantics over the PEER ledger (committed blocks,
 whose metadata carries the validator's txflags), gated per-stream by
 the channel ACLs `event/Block` / `event/FilteredBlock`
-(peer/aclmgmt.py).  The stream blocks at the chain tip on the
-ledger's commit notification (KvLedger.height_changed), the analog of
-the reference's CommitNotifier.
+(peer/aclmgmt.py).  Since ISSUE 17 the server rides the shared
+per-block fan-out engine (peer/fanout.py): each block is materialized
+and encoded ONCE per form into a bounded ring, streams park on the
+ledger's CommitNotifier (one notifier thread, zero tick wakeups), and
+the session ACL re-check is batched per (resource, creator) group —
+see the fanout module docstring for the full contract.
 
 Client side: `EventDeliverClient` signs SeekInfo envelopes and exposes
 `wait_for_tx` — scan filtered blocks until a txid appears and return
@@ -23,77 +26,25 @@ import threading
 from typing import Callable, Iterator, Optional, Tuple
 
 from fabric_mod_tpu.comm.grpc_comm import GRPCClient, GRPCServer, MethodKind
+from fabric_mod_tpu.concurrency import CancellationEvent
+from fabric_mod_tpu.observability.metrics import (MetricOpts,
+                                                  default_provider)
+from fabric_mod_tpu.peer.fanout import (FanoutEngine, _filtered_actions,
+                                        _is_config_block, filtered_block)
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
 from fabric_mod_tpu.protos.protoutil import SignedData
+from fabric_mod_tpu.utils import knobs
+
+__all__ = ["EventDeliverServer", "EventDeliverClient", "EventStreamError",
+           "filtered_block", "make_signed_seek_envelope"]
+
+# the projection primitives live in peer/fanout.py (the fan-out engine
+# is the layer below this service); re-exported here because this
+# module is their historical home
+_ = (_filtered_actions, _is_config_block)
 
 SERVICE = "protos.Deliver"
-
-
-# ---------------------------------------------------------------------------
-# Filtered-block construction (reference: deliverevents.go:293)
-# ---------------------------------------------------------------------------
-
-def filtered_block(channel_id: str, block: m.Block) -> m.FilteredBlock:
-    """Project a committed block to its filtered form: per-tx txid,
-    header type, validation code, and chaincode events with the
-    payload NILLED (the reference strips event payloads so filtered
-    streams never leak application data)."""
-    flags = protoutil.block_txflags(block)
-    ftxs = []
-    for i, env in enumerate(protoutil.get_envelopes(block)):
-        code = (flags[i] if i < len(flags)
-                else m.TxValidationCode.NOT_VALIDATED)
-        try:
-            payload = protoutil.unmarshal_envelope_payload(env)
-            ch = m.ChannelHeader.decode(payload.header.channel_header)
-        except Exception:
-            ftxs.append(m.FilteredTransaction(tx_validation_code=code))
-            continue
-        ftx = m.FilteredTransaction(txid=ch.tx_id, type=ch.type,
-                                    tx_validation_code=code)
-        if ch.type == m.HeaderType.ENDORSER_TRANSACTION:
-            try:
-                ftx.transaction_actions = _filtered_actions(payload.data)
-            except Exception:  # fmtlint: allow[swallowed-exceptions] -- malformed tx body: the filtered event still carries txid+code, which is the contract
-                pass
-        ftxs.append(ftx)
-    return m.FilteredBlock(channel_id=channel_id,
-                           number=block.header.number,
-                           filtered_transactions=ftxs)
-
-
-def _is_config_block(block: m.Block) -> bool:
-    """Whether a committed block carries a channel config transaction
-    (first envelope's header type; config blocks hold exactly one)."""
-    try:
-        env = protoutil.get_envelopes(block)[0]
-        payload = protoutil.unmarshal_envelope_payload(env)
-        ch = m.ChannelHeader.decode(payload.header.channel_header)
-        return ch.type == m.HeaderType.CONFIG
-    except Exception:
-        return False
-
-
-def _filtered_actions(tx_bytes: bytes) -> m.FilteredTransactionActions:
-    actions = []
-    tx = m.Transaction.decode(tx_bytes)
-    for action in tx.actions:
-        cap = m.ChaincodeActionPayload.decode(action.payload)
-        if cap.action is None:
-            continue
-        prp = m.ProposalResponsePayload.decode(
-            cap.action.proposal_response_payload)
-        cca = m.ChaincodeAction.decode(prp.extension)
-        event = None
-        if cca.events:
-            ev = m.ChaincodeEvent.decode(cca.events)
-            # payload stripped, per the reference's filtered contract
-            event = m.ChaincodeEvent(chaincode_id=ev.chaincode_id,
-                                     tx_id=ev.tx_id,
-                                     event_name=ev.event_name)
-        actions.append(m.FilteredChaincodeAction(chaincode_event=event))
-    return m.FilteredTransactionActions(chaincode_actions=actions)
 
 
 # ---------------------------------------------------------------------------
@@ -106,7 +57,9 @@ class EventDeliverServer:
     `acl` is a peer ACLProvider; each stream's first envelope is
     checked against event/Block or event/FilteredBlock before any
     block flows (reference: deliverevents.go's per-stream policy
-    check via the deliver.Handler's access control)."""
+    check via the deliver.Handler's access control).  Session
+    re-checks after admission are batched per (resource, creator)
+    group by the fan-out engine."""
 
     def __init__(self, channel_id: str, ledger, acl,
                  grpc: Optional[GRPCServer] = None,
@@ -114,23 +67,30 @@ class EventDeliverServer:
                  server_cert_pem: Optional[bytes] = None,
                  server_key_pem: Optional[bytes] = None,
                  client_root_pem: Optional[bytes] = None,
-                 max_streams: int = 40):
+                 max_streams: Optional[int] = None):
         self._channel_id = channel_id
         self._ledger = ledger
         self._acl = acl
         self._closing = threading.Event()
+        if max_streams is None:
+            max_streams = knobs.get_int("FABRIC_MOD_TPU_DELIVER_STREAMS")
         # admission cap: each BLOCK_UNTIL_READY stream parks a gRPC
         # worker thread at the tip; without a bound, standing event
         # subscriptions could exhaust a shared listener's pool and
         # starve ProcessProposal (the reference bounds this with its
         # grpc server's stream limits + deliver handler accounting)
         self._streams = threading.Semaphore(max_streams)
-        # committed blocks are immutable, so their config/not-config
-        # classification is too: memoized by block number so N
-        # subscribers don't each re-decode every block's first
-        # envelope on the event hot path (GIL-atomic dict ops; a
-        # racing duplicate compute is harmless)
-        self._cfg_memo: dict = {}
+        provider = default_provider()
+        self._m_active = provider.gauge(MetricOpts(
+            "fabric", "deliver", "streams_active",
+            "deliver streams currently admitted", ("channel",)))
+        self._m_rejected = provider.counter(MetricOpts(
+            "fabric", "deliver", "streams_rejected_total",
+            "streams refused SERVICE_UNAVAILABLE at the admission cap",
+            ("channel",)))
+        # the shared fan-out: ring x {full, filtered} + commit
+        # notifier + batched session ACL groups (ISSUE 17 tentpole)
+        self._fanout = FanoutEngine(channel_id, ledger, acl)
         self._owns_grpc = grpc is None
         self._grpc = grpc or GRPCServer(address, server_cert_pem,
                                         server_key_pem, client_root_pem)
@@ -141,39 +101,35 @@ class EventDeliverServer:
                             MethodKind.STREAM_STREAM,
                             self._make_handler(filtered=True))
 
+    @property
+    def fanout(self) -> FanoutEngine:
+        return self._fanout
+
     def start(self) -> None:
         if self._owns_grpc:
             self._grpc.start()
 
     def stop(self, grace: float = 1.0) -> None:
-        # wake every handler parked at the chain tip so shared-listener
-        # shutdown cannot strand worker threads in cond.wait
+        # order matters: flag the close, then the notifier close wakes
+        # every stream parked at the tip (bounded — no tick to wait
+        # out), so shared-listener shutdown cannot strand workers
         self._closing.set()
-        with self._ledger.height_changed:
-            self._ledger.height_changed.notify_all()
+        self._fanout.close()
         if self._owns_grpc:
             self._grpc.stop(grace)
 
     # -- stream handler --------------------------------------------------
 
-    def _block_is_config(self, blk: m.Block) -> bool:
-        # local-read/return: a concurrent stream's clear() between our
-        # store and a re-read must not KeyError a live subscription
-        num = blk.header.number
-        val = self._cfg_memo.get(num)
-        if val is None:
-            val = _is_config_block(blk)
-            if len(self._cfg_memo) > 4096:
-                self._cfg_memo.clear()
-            self._cfg_memo[num] = val
-        return val
-
     def _make_handler(self, filtered: bool):
+        form = "filtered" if filtered else "full"
+
         def handle(request_iter, context) -> Iterator[bytes]:
             if not self._streams.acquire(blocking=False):
+                self._m_rejected.with_labels(self._channel_id).add(1)
                 yield m.DeliverResponse(
                     status=m.Status.SERVICE_UNAVAILABLE).encode()
                 return
+            self._m_active.with_labels(self._channel_id).add(1)
             try:
                 for raw in request_iter:
                     status, seek, recheck = self._check_request(
@@ -181,27 +137,22 @@ class EventDeliverServer:
                     if seek is None:
                         yield m.DeliverResponse(status=status).encode()
                         return
-                    stop_event = threading.Event()
+                    stop_event = CancellationEvent()
                     context.add_callback(stop_event.set)
                     final = {"status": m.Status.SUCCESS}
-                    for blk in self._blocks(seek, stop_event, final,
-                                            recheck):
-                        if filtered:
-                            resp = m.DeliverResponse(
-                                filtered_block=filtered_block(
-                                    self._channel_id, blk))
-                        else:
-                            resp = m.DeliverResponse(block=blk)
-                        yield resp.encode()
+                    for frame in self._frames(form, seek, stop_event,
+                                              final, recheck):
+                        yield frame
                     yield m.DeliverResponse(
                         status=final["status"]).encode()
             finally:
                 self._streams.release()
+                self._m_active.with_labels(self._channel_id).add(-1)
         return handle
 
     def _check_request(self, raw: bytes, filtered: bool
                        ) -> Tuple[int, Optional[m.SeekInfo],
-                                  Optional[Callable[[], None]]]:
+                                  Optional[Callable[..., None]]]:
         try:
             env = m.Envelope.decode(raw)
             payload = protoutil.unmarshal_envelope_payload(env)
@@ -227,32 +178,27 @@ class EventDeliverServer:
         # snapshot would otherwise record the NEW sequence against a
         # verdict computed under the OLD config, and the session
         # re-check below would never fire for it
-        seq_of = getattr(self._acl, "config_sequence", None)
-        state = {"seq": seq_of() if seq_of is not None else None}
+        seq0 = self._fanout.acl_groups.sequence()
+        # the initial admission check stays PER STREAM: it is the one
+        # verification of THIS stream's seek signature
         try:
             self._acl.check_acl(resource, [sd])
         except Exception:
             return m.Status.FORBIDDEN, None, None
-        # the session re-check: the ACL provider reads the CURRENT
-        # channel bundle, so re-running this closure after a config
-        # block commits evaluates the NEW config (reference:
-        # common/deliver/deliver.go:157-199 — SessionAC re-evaluates
-        # when the config sequence advances).  Cached by sequence: a
-        # full check re-verifies the seek signature against channel
-        # policy, too expensive per block — so the closure is a no-op
-        # until the sequence moves (or `force`, for a config block
-        # flowing through THIS stream, which revokes even when the
-        # bundle swap isn't visible as a sequence change).
+        # the session re-check: re-evaluated against the CURRENT
+        # channel bundle when the config sequence advances, forced for
+        # a config block flowing through THIS stream (reference:
+        # common/deliver/deliver.go:157-199 SessionAC).  Batched:
+        # streams sharing (resource, creator) evaluate ONCE per
+        # (group, sequence [, forced config block]) and fan the
+        # verdict — the per-stream no-op-until-the-sequence-moves
+        # semantics are preserved by the session handle.
+        sess = self._fanout.acl_groups.join(resource, sd, seq0)
+        return m.Status.SUCCESS, seek, sess.recheck
 
-        def recheck(force: bool = False) -> None:
-            seq = seq_of() if seq_of is not None else None
-            if force or seq != state["seq"]:
-                state["seq"] = seq
-                self._acl.check_acl(resource, [sd])
-        return m.Status.SUCCESS, seek, recheck
-
-    def _blocks(self, seek: m.SeekInfo, stop_event: threading.Event,
-                final: dict, recheck=None) -> Iterator[m.Block]:
+    def _frames(self, form: str, seek: m.SeekInfo,
+                stop_event: CancellationEvent, final: dict,
+                recheck=None) -> Iterator[bytes]:
         """BLOCK_UNTIL_READY streams wait at the tip indefinitely —
         the client's gRPC deadline/cancel (via `stop_event`) and
         server close (`_closing`) are the only terminators, so long
@@ -261,44 +207,49 @@ class EventDeliverServer:
         missing block sets final["status"]=NOT_FOUND — the retryable
         error, not an empty success.
 
-        `recheck` re-evaluates the stream's ACL against the CURRENT
-        channel config before every block send — forced when a config
-        block flows through THIS stream, and whenever the channel's
-        config sequence has advanced (so a bounded or lagging stream
-        that never reaches the config block is still cut off the
-        moment the revoking config commits): a revoked subscriber
-        gets FORBIDDEN before the next block — fail-closed; a
-        standing BLOCK_UNTIL_READY subscription is not a grandfather
-        clause (reference: deliver.go:157-199's session-ACL
-        re-evaluation on config sequence change)."""
-        led = self._ledger
-        h = led.height
+        Frames come from the shared ring (materialized + encoded once
+        per (block, form)); the tip wait parks on the CommitNotifier's
+        per-stream event — woken by the notifier thread on commit, by
+        the stop_event's cancellation hook, or by close, never by a
+        tick.  `recheck` is the stream's batched-session handle:
+        forced (keyed by block number) when a config block flows
+        through THIS stream, and firing whenever the channel's config
+        sequence has advanced — a revoked subscriber gets FORBIDDEN
+        before the next frame, fail-closed; a standing subscription is
+        not a grandfather clause."""
+        engine = self._fanout
+        h = self._ledger.height
         num = protoutil.seek_number(seek.start, h, newest_tip=True) or 0
         stop = protoutil.seek_number(seek.stop, h, newest_tip=False)
-        cond = led.height_changed
-        while stop is None or num <= stop:
-            if stop_event.is_set() or self._closing.is_set():
-                return
-            blk = led.get_block_by_number(num)
-            if blk is not None:
-                if recheck is not None:
-                    try:
-                        recheck(force=self._block_is_config(blk))
-                    except Exception:
-                        final["status"] = m.Status.FORBIDDEN
-                        return
-                yield blk
-                num += 1
-                continue
-            if seek.behavior == m.SeekBehavior.FAIL_IF_NOT_READY:
-                final["status"] = m.Status.NOT_FOUND
-                return
-            with cond:
-                if led.height > num:
-                    continue              # raced a commit; re-read
-                # short tick: re-check cancellation/close between waits
-                cond.wait(timeout=1.0)
-        # fallthrough: [start, stop] fully served
+        engine.attach(form)
+        waiter = engine.notifier.waiter()
+        unhook = stop_event.on_set(waiter.cancel)
+        try:
+            while stop is None or num <= stop:
+                if stop_event.is_set() or self._closing.is_set():
+                    return
+                frame = engine.get_frame(form, num)
+                if frame is not None:
+                    if recheck is not None:
+                        try:
+                            recheck(force=frame.is_config,
+                                    config_mark=num)
+                        except Exception:
+                            final["status"] = m.Status.FORBIDDEN
+                            return
+                    yield frame.payload
+                    num += 1
+                    continue
+                if seek.behavior == m.SeekBehavior.FAIL_IF_NOT_READY:
+                    final["status"] = m.Status.NOT_FOUND
+                    return
+                if engine.notifier.wait_above(num, waiter) == "closed":
+                    return
+            # fallthrough: [start, stop] fully served
+        finally:
+            unhook()
+            engine.notifier.release(waiter)
+            engine.detach(form)
 
 
 # ---------------------------------------------------------------------------

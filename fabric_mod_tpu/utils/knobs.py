@@ -138,6 +138,10 @@ declare("FMT_TRACE_JAX_PROFILE", "str", None,
 declare("FMT_SLOW_TESTS", "bool", None,
         "1 enables the multi-minute eager-pairing differentials in "
         "the test suite (excluded from tier-1)")
+declare("FMT_NO_COMPILE_CACHE", "bool", None,
+        "1 disables the persistent XLA compilation cache the test "
+        "harness keeps under .cache/jax (use to time cold compiles); "
+        "unset, repeat suite runs skip every unchanged kernel compile")
 
 # -- soak harness -----------------------------------------------------------
 declare("FMT_SOAK_SEED", "int", 8,
@@ -262,6 +266,15 @@ declare("FABRIC_MOD_TPU_WAL_GROUP_COMMIT", "bool", None,
         "(one fsync covers every entry appended since the last "
         "barrier, still BEFORE any ack/commit); unset = fsync per "
         "append")
+
+# -- peer deliver fan-out ---------------------------------------------------
+declare("FABRIC_MOD_TPU_DELIVER_STREAMS", "int", 40,
+        "peer event-deliver admission cap (streams per channel "
+        "service); past it new streams get SERVICE_UNAVAILABLE")
+declare("FABRIC_MOD_TPU_FANOUT_RING", "int", 128,
+        "per-(channel, form) deliver fan-out ring depth: blocks kept "
+        "as ready-to-send frames; subscribers lagging past the tail "
+        "fall back to a counted per-stream ledger re-read")
 
 # -- retries / gossip -------------------------------------------------------
 declare("FABRIC_MOD_TPU_RETRY_BASE_S", "float", 0.05,

@@ -127,6 +127,13 @@ MATRIX = [
     # invariant gates before the sustained mixed tx/s is recorded
     ("soak", ["--metric", "soak", "--soak-seed", "8",
               "--soak-events", "12"], {}, 1200),
+    # host-only shared deliver fan-out at full scale: 10k mixed
+    # full/filtered subscribers over sustained commit traffic; every
+    # swept point gates byte-identity (shared frames == the per-stream
+    # sender's output) + once-per-(block, form) materialization +
+    # once-per-(group, key) session ACLs before blocks*subs/s lands
+    ("deliverfanout_10k", ["--metric", "deliverfanout",
+                           "--subscribers", "10000"], {}, 1200),
     # FMT_TRACE-armed commitpipe on the DEVICE verifier: the traced
     # arm's verdict/fingerprint identity + stage-attribution sum gate
     # run against real hardware, the span ring lands as a Perfetto-

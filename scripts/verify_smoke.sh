@@ -125,6 +125,18 @@ FMT_RACECHECK=1 JAX_PLATFORMS=cpu \
     python -m pytest -q \
     -p no:cacheprovider -p no:randomly -m 'not slow' \
     tests/test_raft.py tests/test_raft_fakeclock.py
+# 0i. the deliver fan-out slice, FMT_RACECHECK=1: the shared-ring
+#     byte-identity differentials (batch projection vs the per-tx
+#     generic decoder, shared frames vs the per-stream sender, fuzzed
+#     tx bodies), the CommitNotifier wake-exactness + cancellation
+#     contracts (one notifier thread, zero tick wakeups), the batched
+#     session-ACL once-per-(group, key) counting, the ring-overflow
+#     fallback accounting, and the deliver.fanout kill seam — every
+#     notifier/stream thread runs with the race guards armed, and the
+#     event-service suite re-runs on the fanout-backed server
+FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
+    -p no:cacheprovider -p no:randomly -m 'not slow' \
+    tests/test_fanout.py tests/test_deliverevents.py
 # CPU XLA compiles of the verify cores run multiple minutes each (the
 # persistent compile cache is TPU-oriented); give the worker room.
 export FABRIC_MOD_TPU_BENCH_TIMEOUT="${FABRIC_MOD_TPU_BENCH_TIMEOUT:-2400}"
@@ -142,9 +154,14 @@ export FABRIC_MOD_TPU_BENCH_TIMEOUT="${FABRIC_MOD_TPU_BENCH_TIMEOUT:-2400}"
 # (sw verifiers, no XLA) — every point's per-channel txflags + state
 # fingerprints gate bit-identical sharded-vs-N-independent-unsharded
 # before any rate lands in the curve
+# deliverfanout: the shared fan-out A/B at smoke scale (sweep up to
+# 400 subscribers, host-only) — the byte-identity gate + the
+# once-per-(block, form) and once-per-(group, key) assertions run on
+# every change; the 10k-subscriber point is the watcher's job
 exec python bench.py --cpu --batch "${SMOKE_BATCH:-64}" --reps 1 \
     --metric diffverify --metric hashverify \
     --metric commitpipe --commitpipe-verifier sw --tensor-policy 1 \
     --metric policyeval --policyeval-verifier sw \
     --metric broadcaststorm --clients 4 --staged-batch 32 \
-    --metric multichannel --multichannel-verifier sw --peers 8
+    --metric multichannel --multichannel-verifier sw --peers 8 \
+    --metric deliverfanout --subscribers 400

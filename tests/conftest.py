@@ -22,6 +22,23 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache, shared across pytest processes.  The
+# crypto cores (p256 ladder/pallas, fp256bn pairing, the sharded verify
+# lowerings) cost several hundred seconds of CPU XLA compile time per
+# cold run; with the cache primed a full tier-1 pass spends none of it.
+# Keyed by HLO + compile options, so a genuine kernel change recompiles
+# and re-caches automatically.  Opt out with FMT_NO_COMPILE_CACHE=1
+# (e.g. to time cold compiles).
+if os.environ.get("FMT_NO_COMPILE_CACHE", "") in ("", "0"):
+    _cache_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".cache", "jax",
+    )
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 import pytest  # noqa: E402
 
 # FMT_RACECHECK=1 arms every guard in fabric_mod_tpu/concurrency for

@@ -1,0 +1,471 @@
+"""Shared per-block deliver fan-out (peer/fanout.py, ISSUE 17).
+
+The engine's whole claim is an identity: every stream receives frames
+BIT-IDENTICAL to what the historical per-stream sender (re-fetch,
+re-project per tx, re-encode) would have built — materialized once
+instead of N times.  These tests pin that identity over adversarial
+block content, plus the ring/fallback accounting, the notifier's wake
+exactness (meaningful under FMT_RACECHECK=1, which the smoke slice
+sets), the batched session-ACL once-per-(group, key) contract, and the
+deliver.fanout chaos seam.
+"""
+import threading
+import time
+
+import pytest
+
+from fabric_mod_tpu import faults
+from fabric_mod_tpu.concurrency import CancellationEvent
+from fabric_mod_tpu.ledger.notifier import CommitNotifier
+from fabric_mod_tpu.peer.fanout import (AclGroups, FanoutEngine,
+                                        _ConfigMemo, _filtered_actions,
+                                        encode_frame, filtered_block)
+from fabric_mod_tpu.protos import batchdecode
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+from fabric_mod_tpu.protos.protoutil import SignedData
+
+CH = "fanout-ch"
+V = m.TxValidationCode
+
+
+# ---------------------------------------------------------------------------
+# Synthetic chain: adversarial variety the projection must survive
+# ---------------------------------------------------------------------------
+
+def _tx_bytes(txid, event_name=None, event_payload=b"secret",
+              nactions=1, no_action=False, empty_action=False):
+    actions = []
+    for _ in range(nactions):
+        if no_action:
+            cap = m.ChaincodeActionPayload()
+        elif empty_action:
+            cap = m.ChaincodeActionPayload(
+                action=m.ChaincodeEndorsedAction())
+        else:
+            ev = b""
+            if event_name is not None:
+                ev = m.ChaincodeEvent(chaincode_id="cc", tx_id=txid,
+                                      event_name=event_name,
+                                      payload=event_payload).encode()
+            cca = m.ChaincodeAction(results=b"rw", events=ev)
+            prp = m.ProposalResponsePayload(proposal_hash=b"h",
+                                            extension=cca.encode())
+            cap = m.ChaincodeActionPayload(
+                chaincode_proposal_payload=b"cpp",
+                action=m.ChaincodeEndorsedAction(
+                    proposal_response_payload=prp.encode(),
+                    endorsements=[m.Endorsement(endorser=b"e",
+                                                signature=b"s")]))
+        actions.append(m.TransactionAction(header=b"sh",
+                                           payload=cap.encode()))
+    return m.Transaction(actions=actions).encode()
+
+
+def _env(txid, htype=m.HeaderType.ENDORSER_TRANSACTION, data=b""):
+    ch = protoutil.make_channel_header(htype, CH, tx_id=txid)
+    sh = protoutil.make_signature_header(b"creator", protoutil.new_nonce())
+    payload = protoutil.make_payload(ch, sh, data)
+    return m.Envelope(payload=payload.encode(), signature=b"sig")
+
+
+def _mk_block(num, envs, prev=b"\x00" * 32):
+    blk = protoutil.new_block(num, prev, envs)
+    protoutil.set_block_txflags(
+        blk, bytes([V.VALID if i % 3 else V.MVCC_READ_CONFLICT
+                    for i in range(len(envs))]))
+    return blk
+
+
+def _chain(n, config_at=()):
+    """n blocks of mixed content: events, event-less txs, multi-action
+    txs (batch dup-reject -> generic fallback), absent/empty actions,
+    malformed bodies (generic raises -> bare ftx), config + other
+    non-endorser types."""
+    blocks = []
+    for b in range(n):
+        if b in config_at:
+            envs = [_env(f"cfg-{b}", htype=m.HeaderType.CONFIG,
+                         data=b"new-config")]
+        else:
+            envs = [
+                _env(f"t{b}-ev", data=_tx_bytes(f"t{b}-ev",
+                                                event_name="moved")),
+                _env(f"t{b}-plain", data=_tx_bytes(f"t{b}-plain")),
+                _env(f"t{b}-multi", data=_tx_bytes(f"t{b}-multi",
+                                                   event_name="m",
+                                                   nactions=2)),
+                _env(f"t{b}-noact", data=_tx_bytes(f"t{b}-noact",
+                                                   no_action=True)),
+                _env(f"t{b}-empty", data=_tx_bytes(f"t{b}-empty",
+                                                   empty_action=True)),
+                _env(f"t{b}-bad", data=b"\xff\xff\xff\xff"),
+                _env(f"t{b}-msg", htype=m.HeaderType.MESSAGE,
+                     data=b"not a tx"),
+            ]
+        blocks.append(_mk_block(b, envs))
+    return blocks
+
+
+class _Ledger:
+    """ledger-shaped fake: height/height_changed/get_block_by_number,
+    commit notification OUTSIDE any store lock (the kvledger order)."""
+
+    def __init__(self, blocks, revealed=None):
+        self._blocks = list(blocks)
+        self._revealed = len(blocks) if revealed is None else revealed
+        self.height_changed = threading.Condition()
+
+    @property
+    def height(self):
+        return self._revealed
+
+    def get_block_by_number(self, num):
+        if 0 <= num < self._revealed:
+            return self._blocks[num]
+        return None
+
+    def reveal(self, n=1):
+        self._revealed = min(len(self._blocks), self._revealed + n)
+        with self.height_changed:
+            self.height_changed.notify_all()
+
+
+class _SeqAcl:
+    """config_sequence-aware counting ACL (the real provider's shape:
+    verdict depends only on (creator, sequence))."""
+
+    def __init__(self):
+        self.seq = 0
+        self.checks = 0
+        self.deny = False
+
+    def config_sequence(self):
+        return self.seq
+
+    def check_acl(self, resource, sds):
+        self.checks += 1
+        if self.deny:
+            raise PermissionError("revoked")
+
+
+# ---------------------------------------------------------------------------
+# Byte-identity: shared batch path vs the historical per-stream path
+# ---------------------------------------------------------------------------
+
+def test_filtered_projection_batch_matches_generic_per_tx():
+    for blk in _chain(6, config_at=(3,)):
+        a = filtered_block(CH, blk, batch=True)
+        b = filtered_block(CH, blk, batch=False)
+        assert a.encode() == b.encode()
+
+
+def test_encode_frame_identity_both_forms():
+    for blk in _chain(4, config_at=(2,)):
+        for form in ("full", "filtered"):
+            assert encode_frame(CH, form, blk, batch=True) == \
+                encode_frame(CH, form, blk, batch=False)
+
+
+def test_decode_filtered_actions_sound_not_complete_under_mutation():
+    """Differential fuzz: wherever the batch scanner returns a value
+    it must equal the generic projection; wherever the generic decode
+    RAISES the batch path must have bailed to None (the fallback owns
+    every malformed outcome)."""
+    base = _tx_bytes("fuzz", event_name="evt", event_payload=b"p" * 40)
+    cases = [base]
+    for i in range(0, len(base), 3):
+        mutated = bytearray(base)
+        mutated[i] ^= 0xFF
+        cases.append(bytes(mutated))
+    for i in range(1, 24):
+        cases.append(base[:i])                     # truncations
+    cases.append(_tx_bytes("nf", event_name="\udcff" if False else "ok"))
+    # a tx whose event strings are NOT valid UTF-8 on the wire
+    ev = m.ChaincodeEvent(chaincode_id="cc", tx_id="x",
+                          event_name="n").encode().replace(b"cc", b"\xff\xfe")
+    cca = m.ChaincodeAction(events=ev)
+    prp = m.ProposalResponsePayload(extension=cca.encode())
+    cap = m.ChaincodeActionPayload(action=m.ChaincodeEndorsedAction(
+        proposal_response_payload=prp.encode()))
+    cases.append(m.Transaction(actions=[m.TransactionAction(
+        payload=cap.encode())]).encode())
+
+    for txb in cases:
+        got = batchdecode.decode_filtered_actions([txb])[0]
+        try:
+            want = _filtered_actions(txb)
+        except Exception:
+            assert got is None, \
+                "batch path claimed a row the generic decoder rejects"
+            continue
+        if got is not None:
+            assert got.encode() == want.encode()
+
+
+# ---------------------------------------------------------------------------
+# Ring: materialize once, mixed subscribers, overflow fallback
+# ---------------------------------------------------------------------------
+
+def test_ring_materializes_once_for_mixed_subscribers():
+    blocks = _chain(8, config_at=(5,))
+    led = _Ledger(blocks, revealed=0)
+    eng = FanoutEngine(CH, led, _SeqAcl(), ring_size=64)
+    try:
+        for form in ("full", "filtered"):
+            eng.attach(form)
+            eng.attach(form)      # two subscribers per form
+        led._revealed = len(blocks)
+        eng._on_commit(led.height)    # the notifier thread's call
+        # every stream -- full, filtered, and one joining mid-chain --
+        # sees frames byte-identical to the per-stream sender's output
+        for form in ("full", "filtered"):
+            for start in (0, 5):       # 5 = joining mid-chain
+                for num in range(start, led.height):
+                    fr = eng.get_frame(form, num)
+                    assert fr.payload == encode_frame(CH, form,
+                                                      blocks[num],
+                                                      batch=False)
+                    assert fr.is_config == (num == 5)
+        for form in ("full", "filtered"):
+            st = eng.stats[form]
+            assert st["materialized"] == len(blocks)
+            assert st["encoded"] == len(blocks)
+            assert st["fallbacks"] == 0
+            assert st["ring_hits"] == len(blocks) + 3  # starts 0 + 5
+    finally:
+        eng.close()
+
+
+def test_idle_form_skips_eager_materialization():
+    led = _Ledger(_chain(3))
+    eng = FanoutEngine(CH, led, _SeqAcl(), ring_size=8)
+    try:
+        eng.attach("filtered")
+        eng._on_commit(led.height)
+        assert eng.stats["filtered"]["materialized"] == 3
+        assert eng.stats["full"]["materialized"] == 0
+    finally:
+        eng.close()
+
+
+def test_slow_subscriber_past_ring_tail_falls_back_counted():
+    blocks = _chain(12)
+    led = _Ledger(blocks)
+    eng = FanoutEngine(CH, led, _SeqAcl(), ring_size=4)
+    try:
+        eng.attach("filtered")
+        eng._on_commit(led.height)
+        st = eng.stats["filtered"]
+        assert st["materialized"] == 4          # only the ring window
+        # a lagging replay of cold history: correct bytes, counted as
+        # fallback, never inserted (repeat pays again)
+        for _ in range(2):
+            fr = eng.get_frame("filtered", 0)
+            assert fr.payload == encode_frame(CH, "filtered", blocks[0],
+                                              batch=False)
+        assert st["fallbacks"] == 2
+        assert st["materialized"] == 4
+        # the hot tip still rides the ring
+        assert eng.get_frame("filtered", 11) is not None
+        assert st["ring_hits"] >= 1
+    finally:
+        eng.close()
+
+
+def test_fault_seam_kills_one_stream_not_the_ring():
+    """deliver.fanout fires on ONE consumer's pull; the ring and every
+    other stream keep serving."""
+    blocks = _chain(4)
+    led = _Ledger(blocks)
+    eng = FanoutEngine(CH, led, _SeqAcl(), ring_size=16)
+    try:
+        eng.attach("full")
+        eng._on_commit(led.height)
+        plan = faults.FaultPlan().add("deliver.fanout", nth=2)
+        with faults.active(plan):
+            assert eng.get_frame("full", 0) is not None   # stream A
+            with pytest.raises(faults.InjectedFault):
+                eng.get_frame("full", 1)                  # stream B dies
+            # A (and any later C) continue across the whole chain
+            for num in range(len(blocks)):
+                fr = eng.get_frame("full", num)
+                assert fr.payload == encode_frame(CH, "full",
+                                                  blocks[num],
+                                                  batch=False)
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# CommitNotifier: wake exactness (run under FMT_RACECHECK=1 in smoke)
+# ---------------------------------------------------------------------------
+
+def test_notifier_wakes_exactly_per_commit_and_never_idle():
+    led = _Ledger(_chain(5), revealed=0)
+    nt = CommitNotifier(led.height_changed, lambda: led.height,
+                        name="t-exact")
+    try:
+        w1, w2 = nt.waiter(), nt.waiter()
+        led.reveal()
+        assert nt.wait_above(-1, w1, timeout_s=5.0) == "commit"
+        assert nt.wait_above(-1, w2, timeout_s=5.0) == "commit"
+        # let the relay's (async) wake for that commit land first
+        deadline = time.time() + 5.0
+        while (w1.wakes < 1 or w2.wakes < 1) and time.time() < deadline:
+            time.sleep(0.01)
+        # parked at the tip: an idle interval generates ZERO wakes
+        base1, base2 = w1.wakes, w2.wakes
+        time.sleep(0.25)
+        assert (w1.wakes, w2.wakes) == (base1, base2)
+        # one wake per OBSERVED commit per waiter — not 0, not a tick
+        # storm (spaced so the relay observes each commit; rapid
+        # commits may legally coalesce into one wake)
+        for i in range(1, 4):
+            led.reveal()
+            deadline = time.time() + 5.0
+            while (w1.wakes - base1 < i or w2.wakes - base2 < i) \
+                    and time.time() < deadline:
+                time.sleep(0.01)
+            assert w1.wakes - base1 == i
+            assert w2.wakes - base2 == i
+        assert nt.wait_above(3, w1, timeout_s=5.0) == "commit"
+    finally:
+        nt.close()
+
+
+def test_notifier_cancellation_and_close_unpark_promptly():
+    led = _Ledger(_chain(2), revealed=2)
+    nt = CommitNotifier(led.height_changed, lambda: led.height,
+                        name="t-cancel")
+    try:
+        w = nt.waiter()
+        stop = CancellationEvent()
+        stop.on_set(w.cancel)
+        res = {}
+
+        def park():
+            res["r"] = nt.wait_above(10, w)      # untimed park
+
+        t = threading.Thread(target=park, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        stop.set()
+        t.join(timeout=5.0)
+        assert not t.is_alive() and res["r"] == "cancelled"
+        nt.release(w)
+        w2 = nt.waiter()
+        res2 = {}
+
+        def park2():
+            res2["r"] = nt.wait_above(10, w2)
+
+        t2 = threading.Thread(target=park2, daemon=True)
+        t2.start()
+        time.sleep(0.05)
+        t0 = time.monotonic()
+        nt.close()
+        t2.join(timeout=5.0)
+        assert not t2.is_alive() and res2["r"] == "closed"
+        # close() is bounded: no tick to wait out
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        nt.close()
+
+
+# ---------------------------------------------------------------------------
+# Batched session ACLs: once per (group, key), fail-closed fan-out
+# ---------------------------------------------------------------------------
+
+def _sd(identity=b"alice"):
+    return SignedData(data=b"d", identity=identity, signature=b"s")
+
+
+def test_group_recheck_fires_once_per_config_sequence_advance():
+    acl = _SeqAcl()
+    groups = AclGroups(acl, CH)
+    sessions = [groups.join("event/FilteredBlock", _sd(), acl.seq)
+                for _ in range(10)]
+    for s in sessions:
+        s.recheck()                      # sequence unmoved: no-ops
+    assert acl.checks == 0
+    acl.seq = 1
+    for s in sessions:
+        s.recheck()
+    assert acl.checks == 1               # ONE evaluation, 10 verdicts
+    assert groups.stats == {"checks": 1, "reuses": 9}
+    for s in sessions:
+        s.recheck()                      # consumed: no-ops again
+    assert acl.checks == 1
+
+
+def test_forced_config_recheck_once_per_block_and_fails_closed():
+    acl = _SeqAcl()
+    groups = AclGroups(acl, CH)
+    sessions = [groups.join("event/Block", _sd(), acl.seq)
+                for _ in range(6)]
+    acl.seq = 1
+    acl.deny = True
+    for s in sessions:
+        with pytest.raises(PermissionError):
+            s.recheck(force=True, config_mark=7)
+    assert acl.checks == 1               # the deny IS fanned, not re-run
+    # distinct config block -> distinct key -> fresh evaluation
+    acl.deny = False
+    acl.seq = 2
+    for s in sessions:
+        s.recheck(force=True, config_mark=9)
+    assert acl.checks == 2
+
+
+def test_groups_split_by_identity_and_resource():
+    acl = _SeqAcl()
+    groups = AclGroups(acl, CH)
+    sa = groups.join("event/Block", _sd(b"alice"), acl.seq)
+    sb = groups.join("event/Block", _sd(b"bob"), acl.seq)
+    sc = groups.join("event/FilteredBlock", _sd(b"alice"), acl.seq)
+    acl.seq = 1
+    for s in (sa, sb, sc):
+        s.recheck()
+    assert acl.checks == 3               # three distinct groups
+
+
+def test_sequenceless_provider_disables_verdict_caching():
+    """No config_sequence => no key under which verdicts are provably
+    stable => every forced check re-evaluates (the historical
+    per-stream behavior; un-revocation stays visible)."""
+    class _Acl:
+        def __init__(self):
+            self.checks = 0
+            self.deny = False
+
+        def check_acl(self, resource, sds):
+            self.checks += 1
+            if self.deny:
+                raise PermissionError("no")
+
+    acl = _Acl()
+    groups = AclGroups(acl, CH)
+    s1 = groups.join("event/Block", _sd(), None)
+    s2 = groups.join("event/Block", _sd(), None)
+    acl.deny = True
+    with pytest.raises(PermissionError):
+        s1.recheck(force=True, config_mark=3)
+    acl.deny = False
+    s2.recheck(force=True, config_mark=3)     # NOT poisoned by s1's deny
+    assert acl.checks == 2
+
+
+# ---------------------------------------------------------------------------
+# Config classification memo: bounded LRU, not a wholesale clear()
+# ---------------------------------------------------------------------------
+
+def test_config_memo_lru_bounded_and_stable():
+    blocks = _chain(20, config_at=(7,))
+    memo = _ConfigMemo(cap=8)
+    for blk in blocks:
+        memo.classify(blk)
+    assert len(memo) == 8                # bounded, evicted one-at-a-time
+    assert memo.classify(blocks[7]) is True
+    assert memo.classify(blocks[6]) is False
+    assert len(memo) == 8
