@@ -37,6 +37,7 @@ DECLARED_POINTS: Set[str] = {
     "orderer.raft.replicate",
     "orderer.raft.submit",
     "orderer.wal.sync",
+    "peer.mvcc.vector",
     "sharding.dispatch",
 }
 

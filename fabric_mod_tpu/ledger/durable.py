@@ -27,6 +27,7 @@ O(#keys), not O(total value bytes).
 from __future__ import annotations
 
 import bisect
+import functools
 import hashlib
 import io
 import os
@@ -35,6 +36,24 @@ import zlib
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from fabric_mod_tpu.ledger.statedb import UpdateBatch, Version
+from fabric_mod_tpu.observability.metrics import (MetricOpts,
+                                                  default_provider)
+
+_BATCH_WRITES_OPTS = MetricOpts(
+    "fabric", "durable", "update_batch_writes",
+    help="apply_updates calls: each is ONE buffered log write + one "
+         "flush/fsync for the whole block's frames.")
+_BATCH_FRAMES_OPTS = MetricOpts(
+    "fabric", "durable", "update_batch_frames",
+    help="Framed records carried by those batched writes (put/del/"
+         "meta/savepoint) — frames-per-write is the batching ratio.")
+
+
+@functools.lru_cache(maxsize=None)
+def _durable_write_metrics():
+    prov = default_provider()
+    return (prov.counter(_BATCH_WRITES_OPTS),
+            prov.counter(_BATCH_FRAMES_OPTS))
 
 _PUT, _DEL, _SAVE, _POST, _META = 0, 1, 2, 3, 4
 
@@ -305,6 +324,18 @@ class DurableStateDB:
         got = self._keydir.get((ns, key))
         return got[2] if got else None
 
+    def get_versions_many(self, pairs) -> List[Optional[Version]]:
+        """Bulk committed-version lookup (vectorized MVCC hash-join):
+        pure keydir probes — no value reads, no log I/O — so a block's
+        whole version resolution is one call even on the durable arm
+        (reference: statedb.BulkOptimizable LoadCommittedVersions)."""
+        keydir = self._keydir
+        out = []
+        for pair in pairs:
+            got = keydir.get(pair)
+            out.append(got[2] if got else None)
+        return out
+
     def get_metadata(self, ns: str, key: str) -> Optional[Dict[str, bytes]]:
         got = self._metadata.get((ns, key))
         return dict(got) if got else None
@@ -341,45 +372,71 @@ class DurableStateDB:
 
     # -- writes ---------------------------------------------------------
     def apply_updates(self, batch: UpdateBatch, block_num: int) -> None:
-        frames = io.BytesIO()
+        # the whole block's frames build into ONE bytearray -> one
+        # buffered write + one flush/fsync (counted): frame headers
+        # are patched in place after each body lands, so nothing is
+        # allocated or syscalled per record
+        blob = bytearray()
+        n_frames = 0
+
+        def begin() -> int:
+            hdr = len(blob)
+            blob.extend(b"\x00" * 8)
+            return hdr
+
+        def end(hdr: int) -> None:
+            mv = memoryview(blob)[hdr + 8:]
+            crc = zlib.crc32(mv)
+            mv.release()
+            struct.pack_into("<II", blob, hdr, len(blob) - hdr - 8, crc)
+
+        def pack_str(s: bytes) -> None:
+            blob.extend(struct.pack("<I", len(s)))
+            blob.extend(s)
+
         staged = []                       # (ns, key, rel_val_off, vlen, ver)
         base = self._log_size
         for (ns, key), (value, version) in sorted(batch.updates.items()):
-            payload = io.BytesIO()
+            hdr = begin()
             if value is None:
-                payload.write(bytes([_DEL]))
-                _pack_str(payload, ns.encode())
-                _pack_str(payload, key.encode())
-                payload.write(struct.pack("<qq", *version))
-                body = payload.getvalue()
+                blob.append(_DEL)
+                pack_str(ns.encode())
+                pack_str(key.encode())
+                blob.extend(struct.pack("<qq", *version))
                 staged.append((ns, key, -1, -1, None))
             else:
-                payload.write(bytes([_PUT]))
-                _pack_str(payload, ns.encode())
-                _pack_str(payload, key.encode())
-                payload.write(struct.pack("<qq", *version))
-                payload.write(struct.pack("<I", len(value)))
-                val_rel = frames.tell() + 8 + payload.tell()
-                payload.write(value)
-                body = payload.getvalue()
-                staged.append((ns, key, val_rel, len(value), version))
-            frames.write(_frame(body))
+                blob.append(_PUT)
+                pack_str(ns.encode())
+                pack_str(key.encode())
+                blob.extend(struct.pack("<qq", *version))
+                blob.extend(struct.pack("<I", len(value)))
+                staged.append((ns, key, len(blob), len(value), version))
+                blob.extend(value)
+            end(hdr)
+            n_frames += 1
         staged_meta = []
         for (ns, key), (entries, version) in sorted(
                 batch.meta_updates.items()):
-            payload = io.BytesIO()
-            payload.write(bytes([_META]))
-            _pack_str(payload, ns.encode())
-            _pack_str(payload, key.encode())
-            payload.write(struct.pack("<qq", *version))
-            payload.write(struct.pack("<I", len(entries)))
+            hdr = begin()
+            blob.append(_META)
+            pack_str(ns.encode())
+            pack_str(key.encode())
+            blob.extend(struct.pack("<qq", *version))
+            blob.extend(struct.pack("<I", len(entries)))
             for name, val in sorted(entries.items()):
-                _pack_str(payload, name.encode())
-                _pack_str(payload, val)
-            frames.write(_frame(payload.getvalue()))
+                pack_str(name.encode())
+                pack_str(val)
+            end(hdr)
             staged_meta.append((ns, key, entries, version))
-        frames.write(_frame(bytes([_SAVE]) + struct.pack("<q", block_num)))
-        blob = frames.getvalue()
+            n_frames += 1
+        hdr = begin()
+        blob.append(_SAVE)
+        blob.extend(struct.pack("<q", block_num))
+        end(hdr)
+        n_frames += 1
+        writes_ctr, frames_ctr = _durable_write_metrics()
+        writes_ctr.add(1)
+        frames_ctr.add(n_frames)
         self._f.write(blob)
         self._f.flush()
         os.fsync(self._f.fileno())

@@ -14,6 +14,7 @@ height is replayed — the ledger *is* the checkpoint (SURVEY.md §5.4).
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 
@@ -21,7 +22,9 @@ from fabric_mod_tpu.utils.racecheck import OrderedLock
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from fabric_mod_tpu.ledger.blkstorage import BlockStore
-from fabric_mod_tpu.ledger.mvcc import validate_and_prepare_batch
+from fabric_mod_tpu.ledger.mvcc import (
+    COLUMNAR, validate_and_prepare_batch,
+    validate_and_prepare_batch_vectorized, vector_mvcc_enabled)
 from fabric_mod_tpu.ledger.rwsetutil import RWSetBuilder, parse_tx_rwset
 from fabric_mod_tpu.ledger.statedb import UpdateBatch, VersionedDB
 from fabric_mod_tpu.observability import tracing
@@ -258,6 +261,11 @@ class KvLedger:
         self._transient = None
         self._pvtstore = None
         self._btl_fn = None
+        # cached state-fingerprint accumulator (XOR of per-entry
+        # hashes): None until the first fingerprint seeds it with a
+        # full scan, then maintained incrementally by every state
+        # mutation through _apply_state_updates
+        self._fp_acc: Optional[int] = None
         # lifecycle deploy events + historical collection configs
         # (reference: cceventmgmt + confighistory) — file-backed, fed
         # by both commit and recovery replay below
@@ -286,6 +294,7 @@ class KvLedger:
             self.state = DurableStateDB(os.path.join(self.dir, "state"))
         else:
             self.state = VersionedDB()
+        self._fp_acc = None
 
     # -- recovery --------------------------------------------------------
     def _recover(self) -> None:
@@ -342,7 +351,7 @@ class KvLedger:
                         {e.name: e.value for e in mw.entries},
                         (num, tx_num))
         if replay_state:
-            self.state.apply_updates(batch, num)
+            self._apply_state_updates(batch, num)
         self.history.commit(num, hist)
         self.confighistory.handle_block_writes(
             num, [(ns, key, value)
@@ -357,11 +366,18 @@ class KvLedger:
 
     # -- commit ----------------------------------------------------------
     def commit_block(self, block: m.Block,
-                     incoming_flags: Optional[List[int]] = None) -> List[int]:
+                     incoming_flags: Optional[List[int]] = None,
+                     rwsets=None) -> List[int]:
         """MVCC-validate + commit a block whose signature/policy
         verdicts are `incoming_flags` (defaults to the flags already in
         the block metadata, e.g. from the validator).  Returns final
-        flags.  (reference: kv_ledger.go:457 CommitLegacy)"""
+        flags.  `rwsets` (batchdecode.BlockRWSets | None) is the
+        validator's stage-time columnar body decode riding the
+        staged→commit handoff: header facts (txid/type) are reused
+        instead of re-decoded, and with FABRIC_MOD_TPU_VECTOR_MVCC
+        armed the accepted rows take the vectorized MVCC over the
+        columnar planes (bit-identical flags, one bulk statedb call).
+        (reference: kv_ledger.go:457 CommitLegacy)"""
         with self._lock:
             num = block.header.number
             if num != self.blockstore.height:
@@ -381,34 +397,59 @@ class KvLedger:
             # extraction) + the version compares — together the
             # conflict-detection cost the vectorized-MVCC roadmap
             # item targets
+            vec = rwsets is not None and vector_mvcc_enabled()
             with tracing.span("mvcc", block=num):
                 txs = []
-                for env, flag in zip(envs, incoming_flags):
-                    try:
-                        ch = protoutil.envelope_channel_header(env)
-                        txid = ch.tx_id
-                    except Exception:
-                        txs.append(
-                            ("", None, m.TxValidationCode.BAD_PAYLOAD))
-                        continue
-                    if ch.type != m.HeaderType.ENDORSER_TRANSACTION:
+                any_col = False
+                for tx_num, (env, flag) in enumerate(
+                        zip(envs, incoming_flags)):
+                    if rwsets is not None and \
+                            rwsets.txids[tx_num] is not None:
+                        # stage-time spine facts, value-identical to
+                        # the generic header decode below
+                        txid = rwsets.txids[tx_num]
+                        ch_type = rwsets.types[tx_num]
+                    else:
+                        try:
+                            ch = protoutil.envelope_channel_header(env)
+                            txid, ch_type = ch.tx_id, ch.type
+                        except Exception:
+                            txs.append(
+                                ("", None,
+                                 m.TxValidationCode.BAD_PAYLOAD))
+                            continue
+                    if ch_type != m.HeaderType.ENDORSER_TRANSACTION:
                         # config/control txs carry no rwset; they
                         # commit with no state effects (their effect is
                         # the bundle swap done by the channel machinery
                         # upstream)
                         txs.append((txid, m.TxReadWriteSet(), flag))
+                    elif vec and rwsets.bodies[tx_num] is not None and \
+                            (self._transient is None
+                             or not rwsets.bodies[tx_num].has_pvt):
+                        # pvt-bearing txs keep the materialized rwset
+                        # when a transient store is wired — _commit_pvt
+                        # walks its collection hashes
+                        txs.append((txid, COLUMNAR, flag))
+                        any_col = True
                     else:
                         txs.append(
                             (txid, tx_rwset_from_envelope(env), flag))
                 with H_STATE_VALIDATION.time():
-                    flags, batch, tx_writes = validate_and_prepare_batch(
-                        txs, self.state, num)
+                    if any_col:
+                        flags, batch, tx_writes = \
+                            validate_and_prepare_batch_vectorized(
+                                txs, self.state, num, rwsets)
+                    else:
+                        flags, batch, tx_writes = \
+                            validate_and_prepare_batch(
+                                txs, self.state, num)
             protoutil.set_block_txflags(block, bytes(flags))
             with tracing.span("ledger_write", block=num):
                 with H_BLOCK_COMMIT.time():
                     self.blockstore.add_block(block)
                 with H_STATE_COMMIT.time():
-                    self.state.apply_updates(batch, num)
+                    self._apply_state_updates(batch, num)
                     # per-tx writes (not the deduped batch) so commit
                     # and recovery replay record identical history
                     self.history.commit(num, tx_writes)
@@ -439,6 +480,10 @@ class KvLedger:
         for tx_num, (txid, rwset, _flag) in enumerate(txs):
             if flags[tx_num] != m.TxValidationCode.VALID or rwset is None:
                 continue
+            if rwset is COLUMNAR:
+                # columnar rows are only taken for bodies without
+                # collection hashes — same as the empty-`hashed` skip
+                continue
             hashed = {}                    # (ns, coll) -> HashedRWSet
             for ns_entry in rwset.ns_rwset:
                 for ch in ns_entry.collection_hashed_rwset:
@@ -464,7 +509,7 @@ class KvLedger:
                                       self._btl_fn(ns, coll))
             consumed.append(txid)
         if len(batch):
-            self.state.apply_updates(batch, num)
+            self._apply_state_updates(batch, num)
         # purge ALL txids this block carried (valid or not — an
         # invalidated private tx would otherwise leak its plaintext in
         # the transient store forever), plus endorsement leftovers
@@ -484,7 +529,7 @@ class KvLedger:
                 if self.state.get_version(pns, key) == (bn, tn):
                     purge_batch.delete(pns, key, (num, 0))
         if len(purge_batch):
-            self.state.apply_updates(purge_batch, num)
+            self._apply_state_updates(purge_batch, num)
         self._pvtstore.purge(num)
         # ONE durability barrier for the whole block's private data —
         # per-collection fsyncs would multiply commit latency by the
@@ -589,7 +634,7 @@ class KvLedger:
             if len(batch):
                 # keep the savepoint where it is: this backfills an old
                 # block, it does not advance commit progress
-                self.state.apply_updates(batch, self.state.savepoint)
+                self._apply_state_updates(batch, self.state.savepoint)
             self._pvtstore.commit(block_num, tx_num, ns, coll, kv,
                                   self._btl_fn(ns, coll))
             return True
@@ -613,6 +658,98 @@ class KvLedger:
                         continue           # forged/stale candidate
         return None
 
+    # -- state fingerprint -----------------------------------------------
+    # The digest is height ‖ an XOR of independent per-entry hashes
+    # (one per state row, one per key's metadata dict).  XOR is the
+    # point: it makes the accumulator ORDER-FREE and INVERTIBLE, so a
+    # commit folds its UpdateBatch in O(batch) — remove the old
+    # entry's hash, add the new one — instead of re-scanning a
+    # million-key state per block.  Each entry hash is an injective
+    # length-prefixed encoding under a domain tag ("S" rows, "M"
+    # metadata), so colliding entries would need a sha256 collision.
+
+    @staticmethod
+    def _fp_entry(tag: bytes, ns: str, key: str, tail: bytes) -> int:
+        h = hashlib.sha256(tag)
+        for part in (ns.encode(), key.encode()):
+            h.update(len(part).to_bytes(4, "big"))
+            h.update(part)
+        h.update(tail)
+        return int.from_bytes(h.digest(), "big")
+
+    @classmethod
+    def _fp_row(cls, ns: str, key: str, value: bytes,
+                ver: Version) -> int:
+        tail = (len(value).to_bytes(4, "big") + value
+                + ver[0].to_bytes(8, "big") + ver[1].to_bytes(8, "big"))
+        return cls._fp_entry(b"S", ns, key, tail)
+
+    @classmethod
+    def _fp_meta(cls, ns: str, key: str,
+                 entries: Dict[str, bytes]) -> int:
+        parts = [len(entries).to_bytes(4, "big")]
+        for name in sorted(entries):
+            for part in (name.encode(), entries[name]):
+                parts.append(len(part).to_bytes(4, "big"))
+                parts.append(part)
+        return cls._fp_entry(b"M", ns, key, b"".join(parts))
+
+    def _fp_scan_acc(self) -> int:
+        acc = 0
+        for ns, key, value, ver in self.state.iter_state():
+            acc ^= self._fp_row(ns, key, value, ver)
+        for ns, key, entries in self.state.iter_metadata():
+            acc ^= self._fp_meta(ns, key, entries)
+        return acc
+
+    def _fp_fold(self, batch: UpdateBatch) -> None:
+        """Fold one UpdateBatch into the cached accumulator — the
+        exact delta statedb.apply_updates is about to make (put keeps
+        metadata, delete drops it, metadata writes bump the row
+        version and skip rows absent after the value pass).  Called
+        BEFORE the apply so the old entries are still readable."""
+        acc = self._fp_acc
+        state = self.state
+        for (ns, key), (value, version) in batch.updates.items():
+            old = state.get_state(ns, key)
+            if old is not None:
+                acc ^= self._fp_row(ns, key, old[0], old[1])
+                if value is None:
+                    oldm = state.get_metadata(ns, key)
+                    if oldm:
+                        acc ^= self._fp_meta(ns, key, oldm)
+            if value is not None:
+                acc ^= self._fp_row(ns, key, value, version)
+        for (ns, key), (entries, version) in batch.meta_updates.items():
+            upd = batch.updates.get((ns, key))
+            if upd is not None:
+                value, ver = upd
+                if value is None:
+                    continue          # row gone after the value pass
+            else:
+                got = state.get_state(ns, key)
+                if got is None:
+                    continue          # metadata without a key: no-op
+                value, ver = got
+            acc ^= self._fp_row(ns, key, value, ver)
+            acc ^= self._fp_row(ns, key, value, version)
+            oldm = state.get_metadata(ns, key)
+            if oldm:
+                acc ^= self._fp_meta(ns, key, oldm)
+            if entries:
+                acc ^= self._fp_meta(ns, key, dict(entries))
+        self._fp_acc = acc
+
+    def _apply_state_updates(self, batch: UpdateBatch,
+                             height: int) -> None:
+        """EVERY state mutation funnels through here (commit, pvt
+        plaintext, BTL purge, reconciliation backfill, recovery
+        replay) so the fingerprint accumulator can never silently
+        drift from the statedb it summarizes."""
+        if self._fp_acc is not None and len(batch):
+            self._fp_fold(batch)
+        self.state.apply_updates(batch, height)
+
     # -- queries ---------------------------------------------------------
     def state_fingerprint(self) -> str:
         """Deterministic digest of the ENTIRE committed state: every
@@ -620,8 +757,11 @@ class KvLedger:
         (VALIDATION_PARAMETER included) plus the chain height.  Two
         ledgers that committed the same blocks with the same verdicts
         agree bit-for-bit — the commit-pipeline differential's
-        equality oracle (bench.py --metric commitpipe,
-        tests/test_commitpipe.py).
+        equality oracle (bench.py --metric commitpipe/statescale,
+        tests/test_commitpipe.py).  The first call full-scans to seed
+        the accumulator; later calls are O(1) because every commit
+        folded its own delta (state_fingerprint_full stays as the
+        scan-from-scratch oracle).
 
         Taken under the COMMIT lock: commit_block advances the block
         store before applying state, so an unlocked scan racing an
@@ -629,35 +769,23 @@ class KvLedger:
         missing — a phantom divergence that is pure read timing (the
         soak harness's convergence checker hit exactly this on the
         freshest block of whichever peer committed last)."""
-        import hashlib
         with tracing.span("fingerprint", channel=self.ledger_id):
             with self._lock:
-                return self._state_fingerprint_locked(hashlib.sha256())
+                if self._fp_acc is None:
+                    self._fp_acc = self._fp_scan_acc()
+                h = hashlib.sha256(self.height.to_bytes(8, "big"))
+                h.update(self._fp_acc.to_bytes(32, "big"))
+                return h.hexdigest()
 
-    def _state_fingerprint_locked(self, h) -> str:
-        h.update(self.height.to_bytes(8, "big"))
-
-        def upd(b: bytes) -> None:
-            h.update(len(b).to_bytes(4, "big"))
-            h.update(b)
-        for ns, key, value, ver in self.state.iter_state():
-            upd(ns.encode())
-            upd(key.encode())
-            upd(value)
-            h.update(ver[0].to_bytes(8, "big") + ver[1].to_bytes(8, "big"))
-        # section marker + per-key entry COUNT keep the encoding
-        # injective: without them a key with 3 metadata entries and a
-        # key with 1 entry followed by another (ns, key) pair could
-        # hash to the same byte stream
-        h.update(b"\x00METADATA\x00")
-        for ns, key, entries in self.state.iter_metadata():
-            upd(ns.encode())
-            upd(key.encode())
-            h.update(len(entries).to_bytes(4, "big"))
-            for name in sorted(entries):
-                upd(name.encode())
-                upd(entries[name])
-        return h.hexdigest()
+    def state_fingerprint_full(self) -> str:
+        """Scan-from-scratch recompute, bypassing the cached
+        accumulator — the incremental path's differential oracle
+        (tests assert it equals state_fingerprint after arbitrary
+        commit/pvt/reconcile histories)."""
+        with self._lock:
+            h = hashlib.sha256(self.height.to_bytes(8, "big"))
+            h.update(self._fp_scan_acc().to_bytes(32, "big"))
+            return h.hexdigest()
 
     @property
     def height(self) -> int:

@@ -69,6 +69,19 @@ class VersionedDB:
         got = self._data.get((ns, key))
         return got[1] if got else None
 
+    def get_versions_many(self, pairs) -> List[Optional[Version]]:
+        """Bulk committed-version lookup for the vectorized MVCC
+        hash-join: one call resolves every (ns, key) a block touches,
+        so the per-key interface cost is paid once per BLOCK instead
+        of once per read (reference: statedb.BulkOptimizable
+        LoadCommittedVersions)."""
+        data = self._data
+        out = []
+        for pair in pairs:
+            got = data.get(pair)
+            out.append(got[1] if got else None)
+        return out
+
     def get_metadata(self, ns: str, key: str) -> Optional[Dict[str, bytes]]:
         """Key metadata (e.g. the VALIDATION_PARAMETER endorsement
         override) — reference: statedb VersionedValue.Metadata."""

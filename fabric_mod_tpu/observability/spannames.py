@@ -17,6 +17,7 @@ from typing import Set
 
 # Keep sorted; the lint rule cross-checks both directions.
 DECLARED_SPANS: Set[str] = {
+    "body_decode",
     "broadcast.handle",
     "broadcast.stage",
     "broadcast.submit",
@@ -27,6 +28,7 @@ DECLARED_SPANS: Set[str] = {
     "gossip.drain",
     "ledger_write",
     "mvcc",
+    "mvcc_vector",
     "policy_device",
     "policy_finish",
     "policy_gather",

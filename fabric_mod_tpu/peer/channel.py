@@ -324,7 +324,9 @@ class Channel:
         # finish on the validator that staged: its pending evaluators
         # hold that validator's batch slots
         flags = staged.validator.finish(staged)
-        return self.ledger.commit_block(staged.block, flags)
+        return self.ledger.commit_block(
+            staged.block, flags,
+            rwsets=getattr(staged, "rwsets", None))
 
     def committer(self) -> Committer:
         return _ChannelCommitter(self)

@@ -130,7 +130,9 @@ class ValidatorCommitTarget:
 
     def commit_staged(self, staged) -> List[int]:
         flags = staged.validator.finish(staged)
-        return self.ledger.commit_block(staged.block, flags)
+        return self.ledger.commit_block(
+            staged.block, flags,
+            rwsets=getattr(staged, "rwsets", None))
 
 
 class PipelinedCommitter:

@@ -58,6 +58,11 @@ _RAW_ITEMS_OPTS = MetricOpts(
     help="Staged items carrying raw messages instead of host digests "
          "(FABRIC_MOD_TPU_FUSED_HASH: e = H(m) computed on device in "
          "the same program as the verify).")
+_BODY_FALLBACK_OPTS = MetricOpts(
+    "fabric", "validator", "body_decode_fallbacks",
+    help="Endorser-tx bodies the columnar batch decoder could not "
+         "prove clean — staged through the generic per-tx decode "
+         "instead (identical outcome, serial speed).")
 
 
 @functools.lru_cache(maxsize=None)
@@ -66,7 +71,8 @@ def _stage_metrics():
     return (prov.histogram(_STAGED_ITEMS_OPTS,
                            buckets=(1, 8, 64, 256, 512, 1024, 2048)),
             prov.counter(_DEDUP_SAVED_OPTS),
-            prov.counter(_RAW_ITEMS_OPTS))
+            prov.counter(_RAW_ITEMS_OPTS),
+            prov.counter(_BODY_FALLBACK_OPTS))
 
 
 class ValidationInfoProvider:
@@ -152,9 +158,10 @@ class StagedBlock:
     batch verify) while the host copy is still materializing."""
 
     __slots__ = ("block", "validator", "works", "mask_fn", "_mask",
-                 "trace_timeline", "session")
+                 "trace_timeline", "session", "rwsets")
 
-    def __init__(self, block, validator, works, mask_fn, session=None):
+    def __init__(self, block, validator, works, mask_fn, session=None,
+                 rwsets=None):
         self.block = block
         self.validator = validator
         self.works = works
@@ -162,6 +169,10 @@ class StagedBlock:
         self._mask = None
         self.trace_timeline = None
         self.session = session
+        # the stage-time columnar rwset planes (batchdecode.
+        # BlockRWSets | None) — commit_block's vectorized MVCC
+        # consumes them so the block's tx bodies are decoded ONCE
+        self.rwsets = rwsets
 
     def resolve_mask(self):
         """Await the device verdicts (idempotent).  The commit
@@ -245,13 +256,18 @@ class TxValidator:
     # -- pass 1: host unpack + staging -----------------------------------
     def _stage_tx(self, env: m.Envelope, work: _TxWork,
                   collector: BatchCollector, inblock_vp,
-                  session=None, spine=None) -> None:
+                  session=None, spine=None, body=None) -> None:
         """Syntactic validation + creator/endorsement staging for one
         tx.  Sets work.flag on terminal failure, else leaves VALID
         pending the device verdicts.  `spine` (protos/batchdecode) is
         the batch pre-pass's already-decoded envelope/payload/header
         layers — value-identical to the generic decode below, which
         stays as the per-tx fallback for rows the scanner rejected.
+        `body` (batchdecode.TxBody) is the columnar batch decoder's
+        staged endorser-tx body for this row: the exact ns / prp /
+        endorsement / written-key values the generic decode chain
+        below would produce, already validated transitively — rows it
+        could not prove take the generic chain (counted).
         (reference: msgvalidation.go:248 ValidateTransaction)"""
         if not env.payload:
             work.flag = V.NIL_ENVELOPE
@@ -308,6 +324,14 @@ class TxValidator:
         # previous block's commit in the pipelined path, and only at
         # finish time is the committed store guaranteed current.
 
+        if body is not None:
+            # columnar fast path: the batch decoder already produced
+            # this tx's staged body view (single action — the scanner
+            # rejects multi-action txs into the fallback), so staging
+            # reads fields instead of re-decoding six proto layers
+            self._stage_body(body, work, collector, inblock_vp, session)
+            return
+
         # endorsement policy per action (reference: VSCC v20
         # validation_logic.go:185 + validator_keylevel.go:245-258:
         # data = proposal-response-payload ‖ endorser-identity)
@@ -360,24 +384,66 @@ class TxValidator:
             work.flag = V.INVALID_ENDORSER_TRANSACTION
             return
 
-    def _resolve_vinfo(self, ns: str, rwset):
+    def _stage_body(self, body, work, collector, inblock_vp,
+                    session=None) -> None:
+        """Stage one scanner-accepted endorser-tx body — the columnar
+        twin of _stage_tx's generic action loop, consuming the values
+        batchdecode already proved instead of re-decoding them.  Every
+        flag it can set is one the generic chain sets on the same
+        bytes (the decoder's soundness gate)."""
+        try:
+            if body.no_action:
+                work.flag = V.NIL_TXACTION
+                return
+            if not body.endorsements:
+                work.flag = V.ENDORSEMENT_POLICY_FAILURE
+                return
+            ns = body.ns
+            plugin_name, policy_bytes = self._resolve_vinfo(
+                ns, None, keys=body.lifecycle_write_keys(ns))
+            evaluator = self._plugins.resolve(plugin_name,
+                                              self._policy_eval)
+            if evaluator is None:
+                work.flag = V.INVALID_OTHER_REASON
+                return
+            sds = [SignedData(data=body.prp + endorser,
+                              identity=endorser,
+                              signature=signature)
+                   for endorser, signature in body.endorsements]
+            if session is not None and getattr(
+                    evaluator, "supports_tensor_session", False):
+                cc_pending = evaluator.prepare(
+                    policy_bytes, sds, collector, session)
+            else:
+                cc_pending = evaluator.prepare(
+                    policy_bytes, sds, collector)
+            key_evals = self._stage_key_policies_columnar(
+                body, sds, collector, inblock_vp, work, session)
+            work.actions.append(_ActionEval(cc_pending, key_evals))
+        except Exception:
+            work.flag = V.INVALID_ENDORSER_TRANSACTION
+            return
+
+    def _resolve_vinfo(self, ns: str, rwset, keys=None):
         """Validation info for one action; `_lifecycle` writes are
         resolved write-aware when the provider supports it (org-local
         approval txs validate against that org's Endorsement policy —
         see peer/lifecycle.py).  `rwset` is the action's decoded
         TxReadWriteSet (None when cca.results was malformed — fall
         back to tx-level resolution; decode errors are surfaced by
-        validation itself)."""
+        validation itself).  `keys` short-circuits the inner decode
+        when the columnar body already carries this ns's write keys."""
         from fabric_mod_tpu.peer.lifecycle import LIFECYCLE_NS
         write_aware = getattr(self._vinfo, "validation_info_for_writes",
                               None)
         if write_aware is not None and ns == LIFECYCLE_NS and \
-                rwset is not None:
+                (rwset is not None or keys is not None):
             try:
-                keys = [w.key
-                        for nsrw in rwset.ns_rwset
-                        if nsrw.namespace == ns
-                        for w in m.KVRWSet.decode(nsrw.rwset).writes]
+                if keys is None:
+                    keys = [w.key
+                            for nsrw in rwset.ns_rwset
+                            if nsrw.namespace == ns
+                            for w in m.KVRWSet.decode(nsrw.rwset).writes]
                 return write_aware(ns, keys)
             except Exception:  # fmtlint: allow[swallowed-exceptions] -- malformed inner rwset: fall back to tx-level VP resolution; decode errors are surfaced by validation itself
                 pass
@@ -428,6 +494,37 @@ class TxValidator:
                         work.vp_writes.append((ns, mw.key, e.value))
         return key_evals
 
+    def _stage_key_policies_columnar(self, body, sds, collector,
+                                     inblock_vp, work, session=None):
+        """_stage_key_policies over a columnar TxBody: `body.groups`
+        is the per-ns-occurrence written view the generic path derives
+        from parse_tx_rwset — same occurrence order, same per-
+        occurrence key dedup, same eval/vp-write sequence."""
+        key_evals = []
+        for ns, wkeys, metas in body.groups:
+            if wkeys or metas:
+                work.written_ns.add(ns)
+            written = dict.fromkeys(
+                list(wkeys) + [mkey for mkey, _entries in metas])
+            for key in written:
+                committed_pending = None
+                if self._state_metadata is not None:
+                    vp = self._state_metadata(ns, key)
+                    if vp:
+                        committed_pending = self._policy_eval.prepare(
+                            vp, sds, collector, session)
+                cands = inblock_vp.get((ns, key), ())
+                inblock = [(idx, self._policy_eval.prepare(
+                    vp, sds, collector, session))
+                           for idx, vp in cands]
+                key_evals.append(
+                    _KeyEval(ns, key, committed_pending, inblock))
+            for mkey, entries in metas:
+                for name, value in entries:
+                    if name == VALIDATION_PARAMETER:
+                        work.vp_writes.append((ns, mkey, value))
+        return key_evals
+
     # -- the three passes -------------------------------------------------
     def stage(self, block: m.Block) -> "StagedBlock":
         """Passes 1+2: host unpack/staging, then DISPATCH the device
@@ -453,6 +550,30 @@ class TxValidator:
             # could not prove clean come back None and take the
             # generic per-tx decode below (identical outcomes)
             spines = batchdecode.decode_block_spine(block.data.data)
+            # batch body pre-pass: every spine-accepted endorser tx's
+            # payload.data goes through ONE columnar rwset decode
+            # (protos/batchdecode.decode_block_rwsets); accepted
+            # bodies are shared by VP resolution, key-level policy
+            # staging, and — vectorized — MVCC at commit
+            with tracing.span("body_decode",
+                              block=block.header.number,
+                              txs=len(block.data.data)):
+                body_datas: List[Optional[bytes]] = \
+                    [None] * len(block.data.data)
+                for idx, spine in enumerate(spines):
+                    if spine is not None and spine.ch.type == \
+                            m.HeaderType.ENDORSER_TRANSACTION:
+                        body_datas[idx] = spine.payload.data
+                rwsets = batchdecode.decode_block_rwsets(body_datas)
+            if rwsets is not None:
+                # header facts ride along: value-identical to the
+                # generic envelope_channel_header decode commit would
+                # otherwise repeat per tx
+                for idx, spine in enumerate(spines):
+                    if spine is not None:
+                        rwsets.txids[idx] = spine.ch.tx_id
+                        rwsets.types[idx] = spine.ch.type
+                _stage_metrics()[3].add(rwsets.fallbacks)
             for idx, data in enumerate(block.data.data):
                 work = _TxWork()
                 works.append(work)
@@ -465,8 +586,9 @@ class TxValidator:
                     except Exception:
                         work.flag = V.BAD_PAYLOAD
                         continue
+                body = rwsets.bodies[idx] if rwsets is not None else None
                 self._stage_tx(env, work, collector, inblock_vp,
-                               session, spine)
+                               session, spine, body)
                 for ns, key, vp in work.vp_writes:
                     inblock_vp.setdefault((ns, key), []).append((idx, vp))
         if session is not None and len(session):
@@ -485,7 +607,7 @@ class TxValidator:
         # (bccsp/tpu.VerdictCache); within-block repeats never reach
         # it thanks to the collector's dedup, and both effects are
         # exported so coalescing stays observable.
-        staged_hist, dedup_ctr, raw_ctr = _stage_metrics()
+        staged_hist, dedup_ctr, raw_ctr, _fb_ctr = _stage_metrics()
         staged_hist.observe(len(collector.items))
         dedup_ctr.add(collector.requests - len(collector.items))
         # Raw-message items (identities emit them under FABRIC_MOD_
@@ -512,7 +634,7 @@ class TxValidator:
             else:
                 items = collector.items
                 mask_fn = lambda: self._verifier.verify_many(items)
-        return StagedBlock(block, self, works, mask_fn, session)
+        return StagedBlock(block, self, works, mask_fn, session, rwsets)
 
     def finish(self, staged: "StagedBlock") -> List[int]:
         """Pass 3: await the device verdicts, then sequential flag
@@ -617,7 +739,9 @@ class Committer:
         tl = tracing.start_timeline("sync", block.header.number)
         try:
             with tracing.timeline_scope(tl):
-                flags = self.validator.validate(block)
-                return self.ledger.commit_block(block, flags)
+                staged = self.validator.stage(block)
+                flags = self.validator.finish(staged)
+                return self.ledger.commit_block(block, flags,
+                                                rwsets=staged.rwsets)
         finally:
             tracing.finish_timeline(tl)

@@ -217,6 +217,12 @@ declare("FABRIC_MOD_TPU_TENSOR_POLICY", "bool", None,
         "mask/threshold tensors in one program fused downstream of "
         "the batch verify (non-tensorizable trees fall back per "
         "policy); unset = the closure path")
+declare("FABRIC_MOD_TPU_VECTOR_MVCC", "bool", None,
+        "1 runs MVCC over the columnar rwset planes batch-decoded at "
+        "stage time: ONE get_versions_many statedb call per block "
+        "(hash-join) + numpy version compares; rows the scanner "
+        "can't prove fall back per-tx, counted; unset = the serial "
+        "per-key path")
 
 # -- channel sharding -------------------------------------------------------
 declare("FABRIC_MOD_TPU_SHARDS", "int", 0,

@@ -134,6 +134,14 @@ MATRIX = [
     # once-per-(group, key) session ACLs before blocks*subs/s lands
     ("deliverfanout_10k", ["--metric", "deliverfanout",
                            "--subscribers", "10000"], {}, 1200),
+    # host-only vectorized-MVCC state-scale sweep: the same signed
+    # stream committed into ledgers prefilled at 10k/100k/1M keys,
+    # generic vs FABRIC_MOD_TPU_VECTOR_MVCC arms; per-point txflags +
+    # state fingerprints gate bit-identical (and the incremental
+    # fingerprint gates against the full-scan oracle) before any rate
+    # or stage+mvcc bucket second is recorded
+    ("statescale", ["--metric", "statescale",
+                    "--state-keys", "10000,100000,1000000"], {}, 1200),
     # FMT_TRACE-armed commitpipe on the DEVICE verifier: the traced
     # arm's verdict/fingerprint identity + stage-attribution sum gate
     # run against real hardware, the span ring lands as a Perfetto-

@@ -137,6 +137,24 @@ FMT_RACECHECK=1 JAX_PLATFORMS=cpu \
 FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
     -p no:cacheprovider -p no:randomly -m 'not slow' \
     tests/test_fanout.py tests/test_deliverevents.py
+# 0j. the columnar-rwset slice, FMT_RACECHECK=1: the batch tx-body
+#     decode identity + corruption fuzz (accepted rows bit-identical
+#     to the generic decoder, corrupted rows COUNTED into the per-tx
+#     fallback, never a differing verdict), the 60-block vectorized-
+#     vs-generic MVCC differential with mixed columnar/materialized
+#     routing, the knob-armed end-to-end committer differential, the
+#     incremental-vs-full state-fingerprint oracle, and the durable
+#     one-buffered-write batch contract
+FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
+    -p no:cacheprovider -p no:randomly -m 'not slow' \
+    tests/test_vectormvcc.py
+# vectorized-armed commitpipe differential: the whole pipelined/sync/
+# depth1/traced gate set re-run with FABRIC_MOD_TPU_VECTOR_MVCC hot,
+# so the columnar MVCC path is proven inside the real commit pipeline
+# (not just the dedicated statescale A/B) on every change
+FABRIC_MOD_TPU_VECTOR_MVCC=1 python bench.py --cpu \
+    --batch "${SMOKE_BATCH:-64}" --reps 1 \
+    --metric commitpipe --commitpipe-verifier sw
 # CPU XLA compiles of the verify cores run multiple minutes each (the
 # persistent compile cache is TPU-oriented); give the worker room.
 export FABRIC_MOD_TPU_BENCH_TIMEOUT="${FABRIC_MOD_TPU_BENCH_TIMEOUT:-2400}"
@@ -158,10 +176,15 @@ export FABRIC_MOD_TPU_BENCH_TIMEOUT="${FABRIC_MOD_TPU_BENCH_TIMEOUT:-2400}"
 # 400 subscribers, host-only) — the byte-identity gate + the
 # once-per-(block, form) and once-per-(group, key) assertions run on
 # every change; the 10k-subscriber point is the watcher's job
+# statescale: the vectorized-MVCC state-scale differential at smoke
+# sizes (top point 100k keys, host-only) — flags/fingerprint identity,
+# the zero-fallback gate, and the stage+mvcc bucket reduction at the
+# 100k point run on every change; the 1M point is the watcher's job
 exec python bench.py --cpu --batch "${SMOKE_BATCH:-64}" --reps 1 \
     --metric diffverify --metric hashverify \
     --metric commitpipe --commitpipe-verifier sw --tensor-policy 1 \
     --metric policyeval --policyeval-verifier sw \
     --metric broadcaststorm --clients 4 --staged-batch 32 \
     --metric multichannel --multichannel-verifier sw --peers 8 \
-    --metric deliverfanout --subscribers 400
+    --metric deliverfanout --subscribers 400 \
+    --metric statescale --state-keys 2000,20000,100000

@@ -1,0 +1,490 @@
+"""Columnar rwset pipeline + vectorized MVCC differentials (ISSUE 18).
+
+The batch body decoder (protos/batchdecode.decode_block_rwsets) is
+sound-not-complete: every tx it ACCEPTS must yield exactly the values
+the generic Transaction → ... → KVRWSet decode chain yields, and every
+tx it cannot prove must fall back (counted) — a corrupted body may
+only ever change SPEED, never a verdict.  The vectorized MVCC
+(ledger/mvcc.validate_and_prepare_batch_vectorized) must return the
+same (flags, batch, tx_writes) triple as the serial path over any mix
+of columnar / generic / missing rwsets.  The end-to-end knob
+differential closes the loop through staging + commit, and the
+incremental state-fingerprint accumulator is checked against its
+full-scan oracle throughout."""
+import random
+import struct
+
+import pytest
+
+from fabric_mod_tpu.ledger.mvcc import (
+    COLUMNAR, validate_and_prepare_batch,
+    validate_and_prepare_batch_vectorized)
+from fabric_mod_tpu.ledger.rwsetutil import (
+    RWSetBuilder, parse_tx_rwset, range_fingerprint, version_tuple)
+from fabric_mod_tpu.ledger.statedb import UpdateBatch, VersionedDB
+from fabric_mod_tpu.peer.txvalidator import VALIDATION_PARAMETER
+from fabric_mod_tpu.protos import batchdecode
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.protos import protoutil
+
+V = m.TxValidationCode
+
+
+# -- synthetic endorser-tx bodies (no crypto: the decoder never looks
+# at signatures, it only carries them) --------------------------------
+
+def _rand_rwset(rng: random.Random, with_pvt=True) -> bytes:
+    b = RWSetBuilder()
+    n_ns = rng.randrange(1, 3)
+    for nsi in range(n_ns):
+        ns = "cc%d" % nsi
+        for _ in range(rng.randrange(0, 4)):
+            ver = ((rng.randrange(9), rng.randrange(9))
+                   if rng.random() < 0.6 else None)
+            b.add_read(ns, "k%d" % rng.randrange(30), ver)
+        for _ in range(rng.randrange(0, 3)):
+            val = (None if rng.random() < 0.2
+                   else b"v%d" % rng.randrange(1000))
+            b.add_write(ns, "k%d" % rng.randrange(30), val)
+        if rng.random() < 0.3:
+            b.add_range_query(
+                ns, "k1", "k2", rng.random() < 0.5,
+                [("k1", (rng.randrange(5), 0))] if rng.random() < 0.5
+                else [])
+        if rng.random() < 0.3:
+            b.add_metadata_write(ns, "k%d" % rng.randrange(30),
+                                 VALIDATION_PARAMETER,
+                                 b"pol%d" % rng.randrange(4))
+        if rng.random() < 0.3:
+            b.add_metadata_write(ns, "k%d" % rng.randrange(30),
+                                 "OTHER", b"x")
+        if with_pvt and rng.random() < 0.25:
+            b.add_pvt_write(ns, "collA", "pk%d" % rng.randrange(5),
+                            b"secret")
+    return b.build().encode()
+
+
+def _tx_data(rng: random.Random, results: bytes = None,
+             n_endorsers: int = 2, ns: str = "mycc") -> bytes:
+    """One Transaction encoding — what payload.data carries and what
+    decode_block_rwsets scans."""
+    if results is None:
+        results = _rand_rwset(rng)
+    cca = m.ChaincodeAction(
+        results=results, events=b"ev",
+        response=m.Response(status=200, payload=b"rp"),
+        chaincode_id=m.ChaincodeID(name=ns))
+    prp = m.ProposalResponsePayload(
+        proposal_hash=bytes(rng.randrange(256) for _ in range(32)),
+        extension=cca.encode())
+    prp_bytes = prp.encode()
+    ends = [m.Endorsement(endorser=b"org%d-id" % k,
+                          signature=b"sig%d-%d" % (k, rng.randrange(99)))
+            for k in range(n_endorsers)]
+    cap = m.ChaincodeActionPayload(
+        action=m.ChaincodeEndorsedAction(
+            proposal_response_payload=prp_bytes, endorsements=ends))
+    return m.Transaction(
+        actions=[m.TransactionAction(payload=cap.encode())]).encode()
+
+
+def _generic_body(data: bytes):
+    """The generic decode chain _stage_tx runs on payload.data:
+    returns (ns, prp_bytes, [(endorser, sig)], rwset | raises).
+    None => the chain raises (INVALID_ENDORSER_TRANSACTION
+    territory); ('no_action',) => NIL_TXACTION."""
+    tx = m.Transaction.decode(data)
+    if not tx.actions:
+        return ("no_action",)
+    assert len(tx.actions) == 1
+    cca, prp_bytes, ends = protoutil.tx_rwset_and_endorsements(
+        tx.actions[0])
+    ns = cca.chaincode_id.name if cca.chaincode_id is not None else ""
+    rwset = m.TxReadWriteSet.decode(cca.results)
+    return (ns, prp_bytes, [(e.endorser, e.signature) for e in ends],
+            rwset)
+
+
+def _assert_body_matches(body, data):
+    """One accepted TxBody vs the generic oracle on the same bytes."""
+    oracle = _generic_body(data)
+    if oracle == ("no_action",):
+        assert body.no_action
+        return None
+    ns, prp_bytes, ends, rwset = oracle
+    assert not body.no_action
+    assert body.ns == ns
+    assert body.prp == prp_bytes
+    assert body.endorsements == ends
+    has_pvt = any(nsrw.collection_hashed_rwset
+                  for nsrw in rwset.ns_rwset)
+    assert body.has_pvt == has_pvt
+    # groups mirror parse_tx_rwset's per-occurrence written view
+    parsed = parse_tx_rwset(rwset)
+    assert len(body.groups) == len(parsed)
+    for (gns, wkeys, metas), (ons, kv) in zip(body.groups, parsed):
+        assert gns == ons
+        assert wkeys == [w.key for w in kv.writes]
+        assert metas == [
+            (mw.key, [(e.name, e.value) for e in mw.entries])
+            for mw in kv.metadata_writes]
+    return rwset
+
+
+def _tx_planes(rwsets, i):
+    """Slice one tx's plane rows back out of the block arrays."""
+    r = slice(rwsets.read_bounds[i], rwsets.read_bounds[i + 1])
+    w = slice(rwsets.write_bounds[i], rwsets.write_bounds[i + 1])
+    q = slice(rwsets.range_bounds[i], rwsets.range_bounds[i + 1])
+    t = slice(rwsets.meta_bounds[i], rwsets.meta_bounds[i + 1])
+    reads = list(zip(rwsets.read_ns[r.start:r.stop],
+                     rwsets.read_key[r.start:r.stop],
+                     rwsets.read_has_ver[r].tolist(),
+                     rwsets.read_vb[r].tolist(),
+                     rwsets.read_vt[r].tolist()))
+    writes = list(zip(rwsets.write_ns[w.start:w.stop],
+                      rwsets.write_key[w.start:w.stop],
+                      rwsets.write_del[w.start:w.stop],
+                      rwsets.write_val[w.start:w.stop]))
+    ranges = list(zip(rwsets.range_ns[q.start:q.stop],
+                      rwsets.range_rqi[q.start:q.stop]))
+    metas = list(zip(rwsets.meta_ns[t.start:t.stop],
+                     rwsets.meta_key[t.start:t.stop],
+                     rwsets.meta_entries[t.start:t.stop]))
+    return reads, writes, ranges, metas
+
+
+def _assert_planes_match(rwsets, i, rwset):
+    """Plane rows of tx i vs parse_tx_rwset of the generic decode."""
+    reads, writes, ranges, metas = _tx_planes(rwsets, i)
+    e_reads, e_writes, e_ranges, e_metas = [], [], [], []
+    for ns, kv in parse_tx_rwset(rwset):
+        for rd in kv.reads:
+            ver = version_tuple(rd.version)
+            e_reads.append((ns, rd.key, ver is not None,
+                            ver[0] if ver else 0,
+                            ver[1] if ver else 0))
+        for wr in kv.writes:
+            e_writes.append((ns, wr.key, bool(wr.is_delete), wr.value))
+        for rq in kv.range_queries_info:
+            e_ranges.append((ns, rq))
+        for mw in kv.metadata_writes:
+            e_metas.append((ns, mw.key,
+                            [(e.name, e.value) for e in mw.entries]))
+    assert [(a, b, c, d, e) for a, b, c, d, e in reads] == e_reads
+    assert [(a, b, bool(c), d) for a, b, c, d in writes] == e_writes
+    assert len(ranges) == len(e_ranges)
+    for (ns, rqi), (ens, erq) in zip(ranges, e_ranges):
+        assert ns == ens
+        assert rqi.start_key == erq.start_key
+        assert rqi.end_key == erq.end_key
+        assert bool(rqi.itr_exhausted) == bool(erq.itr_exhausted)
+        assert rqi.reads_merkle_hash == erq.reads_merkle_hash
+    assert metas == e_metas
+
+
+# -- the decoder differentials ----------------------------------------
+
+def test_body_decode_identity_wellformed():
+    rng = random.Random(18)
+    datas = [_tx_data(rng) for _ in range(24)]
+    datas[3] = m.Transaction().encode()          # no-action tx
+    datas[7] = _tx_data(rng, n_endorsers=0)      # EPF territory
+    datas[11] = None                             # non-endorser slot
+    rwsets = batchdecode.decode_block_rwsets(datas)
+    assert rwsets is not None
+    assert rwsets.fallbacks == 0
+    for i, data in enumerate(datas):
+        if data is None:
+            assert rwsets.bodies[i] is None
+            continue
+        body = rwsets.bodies[i]
+        assert body is not None
+        rwset = _assert_body_matches(body, data)
+        if rwset is not None:
+            _assert_planes_match(rwsets, i, rwset)
+
+
+def test_body_decode_tiny_block_skipped():
+    rng = random.Random(1)
+    assert batchdecode.decode_block_rwsets(
+        [_tx_data(rng) for _ in range(3)]) is None
+
+
+def test_body_decode_corruption_fuzz():
+    """Sound-not-complete under fire: flip/truncate/append bytes;
+    every accepted row must STILL match the generic oracle, every
+    unprovable row must be a counted fallback — a corruption may never
+    change a decoded value, only force the slow path."""
+    rng = random.Random(77)
+    accepted = fallbacks = 0
+    for round_ in range(120):
+        datas = [_tx_data(rng) for _ in range(5)]
+        j = rng.randrange(len(datas))
+        raw = bytearray(datas[j])
+        mode = rng.randrange(3)
+        if mode == 0 and raw:
+            raw[rng.randrange(len(raw))] ^= 1 << rng.randrange(8)
+        elif mode == 1:
+            raw = raw[:rng.randrange(len(raw) + 1)]
+        else:
+            raw += bytes([rng.randrange(256)
+                          for _ in range(rng.randrange(1, 6))])
+        datas[j] = bytes(raw)
+        rwsets = batchdecode.decode_block_rwsets(datas)
+        assert rwsets is not None
+        fallbacks += rwsets.fallbacks
+        for i, data in enumerate(datas):
+            body = rwsets.bodies[i]
+            if body is None:
+                continue
+            accepted += 1
+            # the oracle may legitimately raise only on rows the
+            # scanner REJECTED; accepted rows must decode identically
+            rwset = _assert_body_matches(body, data)
+            if rwset is not None:
+                _assert_planes_match(rwsets, i, rwset)
+    assert accepted > 300          # the scanner accepts the clean rows
+    assert fallbacks > 20          # ... and the fuzz does reject some
+
+
+# -- the vectorized MVCC differential ---------------------------------
+
+def _prefill(db: VersionedDB, rng: random.Random, n=40):
+    batch = UpdateBatch()
+    for i in range(n):
+        if rng.random() < 0.8:
+            batch.put("cc0", "k%d" % i, b"seed%d" % i,
+                      (rng.randrange(3), rng.randrange(4)))
+        if rng.random() < 0.4:
+            batch.put("cc1", "k%d" % i, b"seed%d" % i,
+                      (rng.randrange(3), rng.randrange(4)))
+    batch.put_metadata("cc0", "k0", {"OTHER": b"m"}, (0, 0))
+    db.apply_updates(batch, 2)
+
+
+def _snapshot_batch(batch: UpdateBatch):
+    return (dict(batch.updates),
+            {k: (dict(e), v) for k, (e, v) in batch.meta_updates.items()})
+
+
+def test_vector_mvcc_matches_generic():
+    """200 random blocks, mixed columnar/generic/None routing, dirty
+    incoming flags, stale reads, honest + bogus range fingerprints,
+    deletes, metadata, in-block conflicts — the (flags, batch,
+    tx_writes) triple must be identical."""
+    rng = random.Random(99)
+    for blk in range(60):
+        n = rng.randrange(5, 12)
+        datas = []
+        for _ in range(n):
+            b = RWSetBuilder()
+            for _ in range(rng.randrange(0, 4)):
+                k = rng.randrange(40)
+                ver = ((rng.randrange(4), rng.randrange(4))
+                       if rng.random() < 0.7 else None)
+                b.add_read("cc%d" % rng.randrange(2), "k%d" % k, ver)
+            for _ in range(rng.randrange(0, 3)):
+                val = (None if rng.random() < 0.25
+                       else b"w%d" % rng.randrange(99))
+                b.add_write("cc%d" % rng.randrange(2),
+                            "k%d" % rng.randrange(40), val)
+            if rng.random() < 0.35:
+                b.add_range_query("cc0", "k1", "k3",
+                                  rng.random() < 0.5,
+                                  [] if rng.random() < 0.5
+                                  else [("k1", (1, 1))])
+            if rng.random() < 0.3:
+                b.add_metadata_write("cc0", "k%d" % rng.randrange(40),
+                                     VALIDATION_PARAMETER, b"p")
+            datas.append(_tx_data(rng, results=b.build().encode()))
+        rwsets = batchdecode.decode_block_rwsets(datas)
+        assert rwsets is not None and rwsets.fallbacks == 0
+
+        db_g, db_v = VersionedDB(), VersionedDB()
+        _prefill(db_g, random.Random(blk))
+        _prefill(db_v, random.Random(blk))
+
+        txs_g, txs_v = [], []
+        for i, data in enumerate(datas):
+            flag = (V.VALID if rng.random() < 0.8
+                    else V.ENDORSEMENT_POLICY_FAILURE)
+            rwset = _generic_body(data)[3]
+            route = rng.random()
+            if route < 0.6:
+                txs_v.append(("t%d" % i, COLUMNAR, flag))
+            elif route < 0.9:
+                txs_v.append(("t%d" % i, rwset, flag))
+            else:
+                txs_v.append(("t%d" % i, None, flag))
+                txs_g.append(("t%d" % i, None, flag))
+                continue
+            txs_g.append(("t%d" % i, rwset, flag))
+
+        fg, bg, wg = validate_and_prepare_batch(txs_g, db_g, 7)
+        fv, bv, wv = validate_and_prepare_batch_vectorized(
+            txs_v, db_v, 7, rwsets)
+        assert fg == fv, (blk, fg, fv)
+        assert _snapshot_batch(bg) == _snapshot_batch(bv)
+        assert wg == wv
+
+
+# -- end-to-end: staging + commit under the knob ----------------------
+
+@pytest.fixture(scope="module")
+def world():
+    from fabric_mod_tpu.bccsp.sw import SwCSP
+    from fabric_mod_tpu.msp import ca as calib
+    from fabric_mod_tpu.msp.identities import SigningIdentity
+    from fabric_mod_tpu.msp.mspimpl import Msp, MspManager
+    csp = SwCSP()
+    msps, signers = [], {}
+    for org in ("Org1", "Org2", "Org3"):
+        ca = calib.CA(f"ca.{org.lower()}", org)
+        msps.append(Msp(org, csp, [ca.cert]))
+        cert, key = ca.issue(f"peer0.{org.lower()}", org, ous=["peer"])
+        signers[org] = SigningIdentity(org, cert, calib.key_pem(key),
+                                       csp)
+    return dict(csp=csp, mgr=MspManager(msps), signers=signers)
+
+
+CHANNEL = "vmvcc"
+
+
+def _signed_stream(world, n_blocks=6, txs_per_block=6, seed=5):
+    from fabric_mod_tpu.policy import from_string
+    rng = random.Random(seed)
+    s = world["signers"]
+    vp = m.ApplicationPolicy(
+        signature_policy=from_string("'Org3.peer'")).encode()
+    blocks, prev = [], b""
+    for bn in range(n_blocks):
+        envs = []
+        for tx in range(txs_per_block):
+            b = RWSetBuilder()
+            k = "k%d" % rng.randrange(12)
+            if rng.random() < 0.5:
+                ver = (rng.randrange(max(bn, 1)), 0) if bn else None
+                b.add_read("mycc", k, ver)
+            b.add_write("mycc", "k%d" % rng.randrange(12),
+                        None if rng.random() < 0.15
+                        else b"v%d.%d" % (bn, tx))
+            if rng.random() < 0.2:
+                b.add_metadata_write("mycc", "k%d" % rng.randrange(12),
+                                     VALIDATION_PARAMETER, vp)
+            if rng.random() < 0.2:
+                b.add_range_query("mycc", "k1", "k4",
+                                  True, [])
+            endorsers = (("Org1",) if rng.random() < 0.25
+                         else ("Org1", "Org2"))
+            envs.append(protoutil.create_signed_tx(
+                CHANNEL, "mycc", b.build().encode(), s["Org1"],
+                [s[o] for o in endorsers]))
+        blk = protoutil.new_block(bn, prev, envs)
+        prev = protoutil.block_header_hash(blk.header)
+        blocks.append(blk.encode())
+    return blocks
+
+
+def _run_stream(world, blocks, root):
+    from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
+    from fabric_mod_tpu.ledger import KvLedger
+    from fabric_mod_tpu.peer import (Committer, TxValidator,
+                                     ValidationInfoProvider)
+    from fabric_mod_tpu.policy import (ApplicationPolicyEvaluator,
+                                       from_string)
+    led = KvLedger(str(root), CHANNEL)
+    vinfo = ValidationInfoProvider(m.ApplicationPolicy(
+        signature_policy=from_string(
+            "OutOf(2, 'Org1.peer', 'Org2.peer', 'Org3.peer')")).encode())
+
+    def state_vp(ns, key):
+        meta = led.state.get_metadata(ns, key)
+        return meta.get(VALIDATION_PARAMETER) if meta else None
+
+    validator = TxValidator(
+        CHANNEL, world["mgr"], ApplicationPolicyEvaluator(world["mgr"]),
+        FakeBatchVerifier(world["csp"]), vinfo,
+        tx_id_exists=led.tx_id_exists, state_metadata=state_vp)
+    committer = Committer(validator, led)
+    flags = [list(committer.store_block(m.Block.decode(raw)))
+             for raw in blocks]
+    # fingerprint mid-history seeds the incremental accumulator ...
+    fp = led.state_fingerprint()
+    # ... and the full-scan oracle must agree with the folded cache
+    assert fp == led.state_fingerprint_full()
+    return flags, fp
+
+
+def test_e2e_knob_differential(world, tmp_path, monkeypatch):
+    from fabric_mod_tpu.peer.txvalidator import _stage_metrics
+    blocks = _signed_stream(world)
+    monkeypatch.delenv("FABRIC_MOD_TPU_VECTOR_MVCC", raising=False)
+    gf, gfp = _run_stream(world, blocks, tmp_path / "generic")
+    fb0 = _stage_metrics()[3].value
+    monkeypatch.setenv("FABRIC_MOD_TPU_VECTOR_MVCC", "1")
+    vf, vfp = _run_stream(world, blocks, tmp_path / "vector")
+    fb1 = _stage_metrics()[3].value
+    assert gf == vf
+    assert gfp == vfp
+    assert fb1 == fb0, "well-formed stream must decode without fallback"
+    assert any(f != V.VALID for bf in gf for f in bf), \
+        "stream should exercise invalid verdicts"
+
+
+def test_incremental_fingerprint_tracks_mutations(world, tmp_path):
+    """Seed the accumulator EARLY, then drive every mutation flavor
+    through commit and compare against the scan-from-scratch oracle
+    at each height."""
+    from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
+    from fabric_mod_tpu.ledger import KvLedger
+    from fabric_mod_tpu.peer import (Committer, TxValidator,
+                                     ValidationInfoProvider)
+    from fabric_mod_tpu.policy import (ApplicationPolicyEvaluator,
+                                       from_string)
+    led = KvLedger(str(tmp_path / "fp"), CHANNEL)
+    vinfo = ValidationInfoProvider(m.ApplicationPolicy(
+        signature_policy=from_string(
+            "OutOf(2, 'Org1.peer', 'Org2.peer', 'Org3.peer')")).encode())
+    validator = TxValidator(
+        CHANNEL, world["mgr"], ApplicationPolicyEvaluator(world["mgr"]),
+        FakeBatchVerifier(world["csp"]), vinfo,
+        tx_id_exists=led.tx_id_exists)
+    committer = Committer(validator, led)
+    assert led.state_fingerprint() == led.state_fingerprint_full()
+    for raw in _signed_stream(world, n_blocks=4, txs_per_block=4,
+                              seed=11):
+        committer.store_block(m.Block.decode(raw))
+        assert led.state_fingerprint() == led.state_fingerprint_full()
+
+
+# -- durable batched block write --------------------------------------
+
+def test_durable_apply_updates_batched(tmp_path):
+    from fabric_mod_tpu.ledger.durable import (DurableStateDB,
+                                               _durable_write_metrics)
+    db = DurableStateDB(str(tmp_path / "state"))
+    w_ctr, f_ctr = _durable_write_metrics()
+    w0, f0 = w_ctr.value, f_ctr.value
+    batch = UpdateBatch()
+    for i in range(10):
+        batch.put("ns", "k%d" % i, b"v%d" % i, (1, i))
+    batch.delete("ns", "k3", (1, 99))
+    batch.put_metadata("ns", "k1", {"a": b"1", "b": b"2"}, (1, 100))
+    db.apply_updates(batch, 1)
+    # one buffered write for the whole block, frames counted
+    assert w_ctr.value - w0 == 1
+    assert f_ctr.value - f0 == len(batch) + 1       # + savepoint frame
+    assert db.get_state("ns", "k2") == (b"v2", (1, 2))
+    assert db.get_state("ns", "k3") is None
+    assert db.get_metadata("ns", "k1") == {"a": b"1", "b": b"2"}
+    assert db.get_versions_many([("ns", "k4"), ("ns", "nope")]) == \
+        [(1, 4), None]
+    db.close()
+    # reopen replays the log: same state
+    db2 = DurableStateDB(str(tmp_path / "state"))
+    assert db2.get_state("ns", "k2") == (b"v2", (1, 2))
+    assert db2.get_state("ns", "k3") is None
+    assert db2.get_metadata("ns", "k1") == {"a": b"1", "b": b"2"}
+    assert db2.savepoint == 1
+    db2.close()
