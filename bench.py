@@ -2057,21 +2057,32 @@ def measure_deliverfanout(n_subscribers: int) -> dict:
     for n_subs in points:
         n_blocks = max(6, min(24, 200_000 // max(1, n_subs)))
         config_at = n_blocks // 2
-        blocks = _fanout_chain(channel_id, n_blocks, config_at)
+        if n_subs >= 100_000:
+            # the 100k top point replays a chain that arrived over the
+            # DISSEMINATION RELAY (read back from a non-leader peer's
+            # ledger) — the fan-out engine's input provably composes
+            # with the tree path, not only a leader's own pull.  Real
+            # committed blocks carry no mid-chain config tx; the
+            # pacer's sequence advance still exercises the standing
+            # session re-check (gate 3's lower bound).
+            cid, blocks = _relayed_chain(n_blocks)
+        else:
+            cid, blocks = channel_id, _fanout_chain(
+                channel_id, n_blocks, config_at)
 
         # reference digests: the per-stream sender's exact output
         refs = {}
         for form in ("full", "filtered"):
             h = hashlib.sha256()
             for blk in blocks:
-                h.update(encode_frame(channel_id, form, blk,
+                h.update(encode_frame(cid, form, blk,
                                       batch=False))
             refs[form] = h.hexdigest()
 
         # -- shared arm ------------------------------------------------
         led = _RevealLedger(blocks)
         acl = _SeqAcl()
-        eng = FanoutEngine(channel_id, led, acl,
+        eng = FanoutEngine(cid, led, acl,
                            ring_size=max(128, n_blocks))
         forms = ["full" if i % 2 else "filtered"
                  for i in range(n_subs)]
@@ -2174,7 +2185,7 @@ def measure_deliverfanout(n_subscribers: int) -> dict:
         for i in range(sample):
             form = forms[i]
             for blk in blocks:
-                h_check[i].update(encode_frame(channel_id, form, blk,
+                h_check[i].update(encode_frame(cid, form, blk,
                                                batch=False))
         per_stream_s = _t.perf_counter() - t0
         for i in range(sample):
@@ -2201,6 +2212,345 @@ def measure_deliverfanout(n_subscribers: int) -> dict:
         f"shared fan-out did not beat per-stream at the top point " \
         f"({ratio:.2f}x)"
     return {"points": results, "top": top, "ratio": ratio}
+
+
+def _build_relay_world(net, fabric, root_dir, n_peers):
+    """`n_peers` relay-mode gossip peers over `net`'s channel, wired
+    for the dissemination A/B: per-peer ledger + channel + GossipNode
+    + RelayService + GossipService, leadership pinned statically to
+    the min-(PKI-ID, endpoint) peer — the SAME peer the dynamic
+    election and RelayService._elected_leader both derive, so the
+    static pin changes nothing about who roots the tree.
+
+    Membership and the tree PARENT's identity are seeded directly
+    into discovery/the identity mapper instead of running alive
+    broadcast rounds: at 128 peers the N^2 signed heartbeats plus
+    N^2 cert validations are minutes of pure-python ECDSA on the
+    fallback CSP — warm-up cost, not the dissemination under test.
+    The relay path itself stays fully signed and fully verified.
+
+    Returns (peers, leader_i, stream_calls): each peer is a dict
+    (node/relay/svc/tap/mgr/channel), `stream_calls` counts deliver-
+    source creations — the orderer-stream-economy gate reads its
+    length."""
+    from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
+    from fabric_mod_tpu.channelconfig import Bundle
+    from fabric_mod_tpu.channelconfig.configtx import config_from_block
+    from fabric_mod_tpu.dissemination import RelayService
+    from fabric_mod_tpu.gossip import GossipNode, GossipService
+    from fabric_mod_tpu.ledger.kvledger import LedgerManager
+    from fabric_mod_tpu.msp import ca as calib
+    from fabric_mod_tpu.msp.identities import SigningIdentity
+    from fabric_mod_tpu.orderer import DeliverService
+    from fabric_mod_tpu.peer.channel import Channel
+    from fabric_mod_tpu.protos import messages as m
+
+    _, config = config_from_block(net.genesis_block)
+    orgs = ("Org1", "Org2", "Org3")
+    peers = []
+    for i in range(n_peers):
+        org = orgs[i % len(orgs)]
+        csp = net.csp
+        mgr = LedgerManager(os.path.join(root_dir, f"relay{i}"))
+        ledger = mgr.create_or_open(net.channel_id)
+        channel = Channel(net.channel_id, ledger,
+                          FakeBatchVerifier(csp),
+                          Bundle(net.channel_id, config, csp), csp)
+        if ledger.height == 0:
+            channel.init_from_genesis(net.genesis_block)
+        cert, key = net.cas[org].issue(f"dis{i}.{org.lower()}", org,
+                                       ous=["peer"])
+        signer = SigningIdentity(org, cert, calib.key_pem(key), csp)
+        node = GossipNode(f"dis{i}:7051", signer, channel, fabric)
+        relay = RelayService(node)
+        tap = []
+        relay.relay.on_deliver = \
+            lambda num, frame, acc=tap: acc.append((num, frame))
+        peers.append({"node": node, "relay": relay, "tap": tap,
+                      "mgr": mgr, "channel": channel})
+    for p in peers:
+        node = p["node"]
+        for other in peers:
+            onode = other["node"]
+            if onode is node:
+                continue
+            node.discovery.handle_alive(onode.pki_id, m.AliveMessage(
+                membership=m.GossipMember(endpoint=onode.endpoint,
+                                          pki_id=onode.pki_id),
+                timestamp=m.PeerTime(inc_num=1, seq_num=1)))
+    leader_i = min(range(n_peers),
+                   key=lambda i: (peers[i]["node"].pki_id,
+                                  peers[i]["node"].endpoint))
+    by_ep = {p["node"].endpoint: p["node"] for p in peers}
+    tree = peers[leader_i]["relay"].tree()
+    for p in peers:
+        parent_ep = tree.parent(p["node"].endpoint)
+        if parent_ep is not None:
+            # the only inbound envelope signer this peer must verify
+            p["node"].mapper.put(by_ep[parent_ep]._identity)
+    stream_calls = []
+
+    def factory():
+        stream_calls.append(1)
+        return DeliverService(net.support)
+
+    for i, p in enumerate(peers):
+        p["svc"] = GossipService(p["node"], factory,
+                                 static_leader=(i == leader_i),
+                                 relay=p["relay"])
+        # long anti-entropy cadence, pinned BEFORE svc.start()'s
+        # idempotent re-start: the quiescent-channel pull hellos are
+        # sqrt-N signed messages per peer per tick — at 128 peers
+        # that storm measures the fallback CSP, not the relay.  The
+        # relay's explicit request_gap prod stays live for repairs.
+        p["node"].state.start(interval_s=120.0)
+    return peers, leader_i, stream_calls
+
+
+def _stop_relay_world(peers, leader_i):
+    # root first, so no push races the children's teardown
+    peers[leader_i]["svc"].stop()
+    for i, p in enumerate(peers):
+        if i != leader_i:
+            p["svc"].stop()
+    for p in peers:
+        p["node"].stop()
+        p["mgr"].close()
+
+
+def _relayed_chain(n_blocks: int) -> tuple:
+    """(channel_id, blocks) for the fan-out sweep's TOP point, read
+    back from a relayed NON-leader peer's ledger: a 4-peer
+    dissemination tree carries ONE orderer deliver stream to every
+    peer, so the chain the 100k-subscriber fan-out replays provably
+    arrived over the relay path, not a per-peer pull."""
+    import tempfile
+    import time as _t
+
+    from fabric_mod_tpu.e2e import Network
+    from fabric_mod_tpu.gossip import InProcNetwork
+
+    tmp = tempfile.mkdtemp(prefix="fmt_dissem_chain_")
+    net = Network(tmp, batch_timeout="50ms", max_message_count=4)
+    try:
+        for i in range(4 * n_blocks):
+            net.invoke([b"put", b"fk%d" % i, b"fv%d" % i])
+        net.pump_committed(4 * n_blocks)
+        target_h = net.support.store.height
+        assert target_h - 1 >= n_blocks, target_h
+        fabric = InProcNetwork()
+        peers, leader_i, streams = _build_relay_world(net, fabric,
+                                                      tmp, 4)
+        try:
+            for i, p in enumerate(peers):
+                if i != leader_i:
+                    p["svc"].start()
+            peers[leader_i]["svc"].start()
+            deadline = _t.perf_counter() + 120.0
+            while _t.perf_counter() < deadline:
+                if all(p["channel"].ledger.height >= target_h
+                       for p in peers):
+                    break
+                _t.sleep(0.005)
+            src = peers[next(i for i in range(len(peers))
+                             if i != leader_i)]
+            assert src["channel"].ledger.height >= target_h, \
+                [p["channel"].ledger.height for p in peers]
+            assert len(streams) == 1, len(streams)
+            got = {num for num, _ in src["tap"]}
+            assert got == set(range(1, target_h)), sorted(got)
+            blocks = [src["channel"].ledger.get_block_by_number(num)
+                      for num in range(1, 1 + n_blocks)]
+        finally:
+            _stop_relay_world(peers, leader_i)
+        return net.channel_id, blocks
+    finally:
+        net.close()
+
+
+def measure_dissemination(n_peers: int) -> dict:
+    """Tree relay vs per-peer orderer pull (host-only A/B).
+
+    Per swept peer count, the SAME pre-committed orderer chain drives
+    (a) relay mode — ONE gossip leader pulls the deliver stream and
+    the degree-d dissemination tree carries each frame to every other
+    peer over the signed gossip comm layer — and (b) all-pull mode —
+    every peer dials its own DeliverClient (the pre-forest cost
+    model).
+
+    Gates, per point, BEFORE any rate is reported:
+      * byte-identity — every relayed frame equals the frame a DIRECT
+        orderer pull produces on a peer (the all-pull arm's committed
+        ledger is the reference encoder — peer commit sets the
+        tx-flags metadata, so the orderer's raw store is NOT the
+        right oracle), and every non-leader received the WHOLE chain
+        through the tree;
+      * convergence — one state fingerprint across all relay-mode
+        peers, equal to the all-pull arm's;
+      * stream economy — the orderer served exactly ONE deliver
+        stream for the whole relay arm (== the number of leaders,
+        the forest's headline contract) while the all-pull arm paid
+        one stream per peer.
+    """
+    import tempfile
+    import threading as th
+    import time as _t
+
+    from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
+    from fabric_mod_tpu.channelconfig import Bundle
+    from fabric_mod_tpu.channelconfig.configtx import config_from_block
+    from fabric_mod_tpu.e2e import Network
+    from fabric_mod_tpu.gossip import InProcNetwork
+    from fabric_mod_tpu.ledger.kvledger import LedgerManager
+    from fabric_mod_tpu.orderer import DeliverService
+    from fabric_mod_tpu.peer.channel import Channel
+    from fabric_mod_tpu.peer.deliverclient import DeliverClient
+    from fabric_mod_tpu.peer.fanout import encode_frame
+
+    points = sorted({8, max(8, n_peers // 4), n_peers})
+    results = []
+    for n in points:
+        tmp = tempfile.mkdtemp(prefix="fmt_dissem_bench_")
+        net = Network(tmp, batch_timeout="50ms", max_message_count=12)
+        try:
+            # ~1 block per tx: each pure-python-signed invoke outlasts
+            # the batch timeout, and the per-(block, peer) MCS verify
+            # + commit (~60ms on the fallback CSP) is what the sweep
+            # scales by — 6 blocks keeps the 128-peer point inside the
+            # worker budget while still measuring a sustained stream
+            n_txs = 6
+            for i in range(n_txs):
+                net.invoke([b"put", b"dk%d" % i, b"dv%d" % i])
+            net.pump_committed(n_txs)
+            target_h = net.support.store.height
+            n_blocks = target_h - 1
+            _, config = config_from_block(net.genesis_block)
+
+            # -- all-pull arm FIRST: its committed ledgers are the
+            # byte-identity gate's reference encoders ----------------
+            pull_streams = []
+
+            def pull_source():
+                pull_streams.append(1)
+                return DeliverService(net.support)
+
+            pulls = []
+            for i in range(n):
+                mgr = LedgerManager(os.path.join(tmp, f"pull{i}"))
+                ledger = mgr.create_or_open(net.channel_id)
+                channel = Channel(net.channel_id, ledger,
+                                  FakeBatchVerifier(net.csp),
+                                  Bundle(net.channel_id, config,
+                                         net.csp), net.csp)
+                if ledger.height == 0:
+                    channel.init_from_genesis(net.genesis_block)
+                pulls.append({"mgr": mgr, "channel": channel,
+                              "client": DeliverClient(channel,
+                                                      pull_source())})
+
+            def pull_main(c):
+                try:
+                    c.run(idle_timeout_s=30.0)
+                except Exception:
+                    pass    # stopped post-convergence; heights gate
+
+            threads = [th.Thread(target=pull_main,
+                                 args=(p["client"],), daemon=True)
+                       for p in pulls]
+            t0 = _t.perf_counter()
+            for t in threads:
+                t.start()
+            deadline = t0 + 180.0 + 0.5 * n
+            while _t.perf_counter() < deadline:
+                if all(p["channel"].ledger.height >= target_h
+                       for p in pulls):
+                    break
+                _t.sleep(0.002)
+            pull_s = _t.perf_counter() - t0
+            heights = [p["channel"].ledger.height for p in pulls]
+            assert all(h >= target_h for h in heights), heights
+            for p in pulls:
+                p["client"].stop()
+            for t in threads:
+                t.join(timeout=30)
+            assert len(pull_streams) == n, len(pull_streams)
+            ref_ledger = pulls[0]["channel"].ledger
+            refs = {num: encode_frame(net.channel_id, "full",
+                                      ref_ledger.get_block_by_number(
+                                          num))
+                    for num in range(1, target_h)}
+            pull_fps = {p["channel"].ledger.state_fingerprint()
+                        for p in pulls}
+            assert len(pull_fps) == 1, pull_fps
+
+            # -- relay arm -------------------------------------------
+            fabric = InProcNetwork()
+            peers, leader_i, relay_streams = _build_relay_world(
+                net, fabric, tmp, n)
+            t0 = _t.perf_counter()
+            for i, p in enumerate(peers):    # children accept BEFORE
+                if i != leader_i:            # the root starts pushing
+                    p["svc"].start()
+            peers[leader_i]["svc"].start()
+            deadline = t0 + 180.0 + 0.5 * n
+            while _t.perf_counter() < deadline:
+                if all(p["channel"].ledger.height >= target_h
+                       for p in peers):
+                    break
+                _t.sleep(0.002)
+            relay_s = _t.perf_counter() - t0
+            heights = [p["channel"].ledger.height for p in peers]
+            assert all(h >= target_h for h in heights), heights
+
+            # gate: ONE orderer deliver stream served n peers
+            assert len(relay_streams) == 1, len(relay_streams)
+            # gate: every non-leader got the WHOLE chain through the
+            # tree, every frame byte-identical to the direct pull
+            for i, p in enumerate(peers):
+                if i == leader_i:
+                    assert not p["tap"]      # the root receives nothing
+                    continue
+                got = dict(p["tap"])
+                assert set(got) == set(range(1, target_h)), \
+                    (i, sorted(got))
+                for num, frame in got.items():
+                    assert frame == refs[num], \
+                        f"peer {i} frame {num} diverged from the " \
+                        f"direct-pull encoding"
+            # gate: convergence, and equal to the all-pull arm's state
+            relay_fps = {p["channel"].ledger.state_fingerprint()
+                         for p in peers}
+            assert relay_fps == pull_fps, (relay_fps, pull_fps)
+            rstats = {k: sum(p["relay"].stats.get(k, 0) for p in peers)
+                      for k in ("pushed", "forwarded", "received",
+                                "dropped", "send_failures",
+                                "repair_prods", "duplicates")}
+            assert rstats["received"] > 0, rstats
+            _stop_relay_world(peers, leader_i)
+            for p in pulls:
+                p["mgr"].close()
+
+            relay_rate = n_blocks * n / relay_s
+            pull_rate = n_blocks * n / pull_s
+            log(f"dissemination: {n} peers x {n_blocks} blocks — "
+                f"relay {relay_rate:,.0f} vs all-pull "
+                f"{pull_rate:,.0f} blocks*peers/s "
+                f"(streams 1 vs {n})")
+            results.append({
+                "peers": n, "blocks": n_blocks,
+                "relay_blocks_peers_per_sec": round(relay_rate, 1),
+                "pull_blocks_peers_per_sec": round(pull_rate, 1),
+                "orderer_streams_relay": len(relay_streams),
+                "orderer_streams_pull": len(pull_streams),
+                "relay_stats": rstats,
+                "identical": True,
+            })
+        finally:
+            net.close()
+    top = results[-1]
+    return {"points": results, "top": top,
+            "ratio": (top["relay_blocks_peers_per_sec"]
+                      / top["pull_blocks_peers_per_sec"])}
 
 
 def measure_broadcaststorm(n_txs: int, n_clients: int = 8,
@@ -2482,6 +2832,31 @@ def _worker_metric(args) -> int:
             "unit": "blocks*subs/s",
             "vs_baseline": round(extras["ratio"], 3),
             "subscribers": extras["top"]["subscribers"],
+            "points": extras["points"],
+        }
+        print(json.dumps(out))
+        return 0
+    if args.metric == "dissemination":
+        # host-only (no device): the relay-vs-all-pull A/B; every rate
+        # is gated by the frame byte-identity, all-peer fingerprint
+        # convergence, and one-deliver-stream-per-leader assertions
+        # inside the measure
+        extras = measure_dissemination(
+            max(8, args.peers if args.peers is not None else 128))
+        out = {
+            "metric": "dissemination_blocks_peers_per_sec",
+            "value": extras["top"]["relay_blocks_peers_per_sec"],
+            "unit": "blocks*peers/s",
+            # relay vs the all-pull arm at the top point: on the CPU
+            # fallback CSP the relay ALSO pays one pure-python
+            # envelope verify per hop, so the honest headline here is
+            # stream economy (1 orderer stream vs n), not the ratio
+            "vs_baseline": round(extras["ratio"], 3),
+            "peers": extras["top"]["peers"],
+            "orderer_streams_relay":
+                extras["top"]["orderer_streams_relay"],
+            "orderer_streams_pull":
+                extras["top"]["orderer_streams_pull"],
             "points": extras["points"],
         }
         print(json.dumps(out))
@@ -2842,7 +3217,8 @@ def supervise(args, argv) -> int:
                          "--multichannel-verifier", "sw"]
             if args.peers is not None:
                 cpu_argv += ["--peers", str(args.peers)]
-        if args.metric == "gossip" and args.peers is not None:
+        if args.metric in ("gossip", "dissemination") \
+                and args.peers is not None:
             cpu_argv += ["--peers", str(args.peers)]
         if args.metric == "broadcaststorm":
             if args.clients is not None:
@@ -2892,7 +3268,8 @@ def main() -> int:
                              "marshal", "diffverify", "hashverify",
                              "commitpipe", "broadcaststorm", "soak",
                              "policyeval", "multichannel",
-                             "deliverfanout", "statescale"),
+                             "deliverfanout", "statescale",
+                             "dissemination"),
                     default=None,
                     help="repeatable: each metric runs in sequence and "
                          "prints its own JSON line (the smoke target "

@@ -114,21 +114,110 @@ def _jac_add_affine(p, q):
     return (nx, ny, nz)
 
 
+def _jac_add(p, q):
+    """General Jacobian + Jacobian addition (None is the identity)."""
+    if p is None:
+        return q
+    if q is None:
+        return p
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    z1z1 = z1 * z1 % P
+    z2z2 = z2 * z2 % P
+    u1 = x1 * z2z2 % P
+    u2 = x2 * z1z1 % P
+    s1 = y1 * z2 * z2z2 % P
+    s2 = y2 * z1 * z1z1 % P
+    if u1 == u2:
+        if s1 != s2:
+            return None
+        return _jac_double(p)
+    h = (u2 - u1) % P
+    i = 4 * h * h % P
+    j = h * i % P
+    rr = 2 * (s2 - s1) % P
+    v = u1 * i % P
+    nx = (rr * rr - j - 2 * v) % P
+    ny = (rr * (v - nx) - 2 * s1 * j) % P
+    nz = 2 * z1 * z2 * h % P
+    return (nx, ny, nz)
+
+
+def _jac_to_affine(p):
+    if p is None:
+        return None
+    zi = pow(p[2], -1, P)
+    zi2 = zi * zi % P
+    return (p[0] * zi2 % P, p[1] * zi2 * zi % P)
+
+
+def _window_row(pt):
+    """[pt, 2*pt, ..., 15*pt] in affine (the 4-bit window table)."""
+    row = [pt]
+    for _ in range(14):
+        row.append(point_add(row[-1], pt))
+    return row
+
+
+# Fixed-base comb for G: 64 rows, row i holding the 1..15 multiples of
+# 2^(4i)*G, so k*G is ~60 mixed additions and ZERO doublings.  Built
+# lazily once per process (~1k affine ops); every sign, every keygen,
+# and half of every verify rides it.
+_G_COMB = None
+
+
+def _g_comb():
+    global _G_COMB
+    if _G_COMB is None:
+        rows, base = [], (GX, GY)
+        for _ in range(64):
+            row = _window_row(base)
+            rows.append(row)
+            base = point_add(row[-1], base)      # 16 * base
+        _G_COMB = rows
+    return _G_COMB
+
+
+def _mul_g_jac(k: int):
+    """k * G (Jacobian) via the fixed-base comb."""
+    acc = None
+    for row in _g_comb():
+        nib = k & 0xF
+        if nib:
+            acc = _jac_add_affine(acc, row[nib - 1])
+        k >>= 4
+        if not k and acc is not None:
+            break
+    return acc
+
+
+def _mul_window_jac(k: int, row):
+    """k * pt (Jacobian) via a precomputed 4-bit window table for pt:
+    256 doublings + ~60 mixed additions instead of ~128."""
+    acc = None
+    for shift in range(252, -4, -4):
+        if acc is not None:
+            acc = _jac_double(_jac_double(_jac_double(_jac_double(acc))))
+        nib = (k >> shift) & 0xF
+        if nib:
+            acc = _jac_add_affine(acc, row[nib - 1])
+    return acc
+
+
 def point_mul(k: int, pt):
-    """k * pt via Jacobian double-and-add — ONE final inversion
-    instead of one per point operation (the fallback's hot loop)."""
+    """k * pt with ONE final inversion (the fallback's hot loop):
+    fixed-base comb when pt is G, windowed Jacobian otherwise."""
     if pt is None or k % N == 0:
         return None
+    k = k % N
+    if pt == (GX, GY):
+        return _jac_to_affine(_mul_g_jac(k))
     acc = None
     for bit in bin(k)[2:]:
         acc = _jac_double(acc)
         if bit == "1":
             acc = _jac_add_affine(acc, pt)
-    if acc is None:
-        return None
-    zi = pow(acc[2], -1, P)
-    zi2 = zi * zi % P
-    return (acc[0] * zi2 % P, acc[1] * zi2 * zi % P)
+    return _jac_to_affine(acc)
 
 
 def on_curve(x: int, y: int) -> bool:
@@ -428,6 +517,7 @@ class EllipticCurvePublicKey:
         if not on_curve(x, y):
             raise ValueError("point is not on P-256")
         self._x, self._y = x, y
+        self._window = None
 
     @classmethod
     def from_encoded_point(cls, curve, data: bytes):
@@ -461,8 +551,14 @@ class EllipticCurvePublicKey:
             raise InvalidSignature("scalar out of range")
         e = int.from_bytes(_digest_for_alg(data, alg), "big")
         w = pow(s, -1, N)
-        pt = point_add(point_mul(e * w % N, (GX, GY)),
-                       point_mul(r * w % N, (self._x, self._y)))
+        if self._window is None:
+            # identities verify many messages: one 15-entry window
+            # table per key amortizes to ~nothing and halves the
+            # per-verify point-op count
+            self._window = _window_row((self._x, self._y))
+        pt = _jac_to_affine(_jac_add(
+            _mul_g_jac(e * w % N),
+            _mul_window_jac(r * w % N, self._window)))
         if pt is None or pt[0] % N != r:
             raise InvalidSignature("verification failed")
 

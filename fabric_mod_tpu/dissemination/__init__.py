@@ -1,0 +1,28 @@
+"""Cross-peer block dissemination: one orderer pull per org, a
+deterministic relay forest to every other peer.
+
+(reference: the gossip layer's org-leader pull + state transfer — here
+grown into a real dissemination subsystem: PR 17's BlockFanout made a
+single peer fan one encoded frame out to 10k local subscribers; this
+package pushes those once-encoded frames ACROSS peers down a relay
+tree every member derives independently, so orderer deliver load is
+O(orgs) regardless of peer count.)
+
+* ``tree.py``     — RelayTree: a pure function of (sorted alive
+                    membership, elected leader, epoch) with fan-out
+                    degree ``FABRIC_MOD_TPU_RELAY_DEGREE``; zero
+                    coordination, deterministic reparenting.
+* ``relay.py``    — BlockRelay: frames off the leader's BlockFanout
+                    ring, pushed child-ward over the existing gossip
+                    comm with bounded per-child queues + counted
+                    drops; gaps fall back to anti-entropy pull.
+* ``service.py``  — RelayService: wired into GossipService leadership
+                    transitions (sole DeliverClient at the leader,
+                    teardown on demotion, rebuild-from-height on
+                    promotion); non-leaders commit through the
+                    existing GossipStateProvider buffer.
+"""
+from fabric_mod_tpu.dissemination.tree import (RelayTree,     # noqa: F401
+                                               reparent_plan)
+from fabric_mod_tpu.dissemination.relay import BlockRelay     # noqa: F401
+from fabric_mod_tpu.dissemination.service import RelayService  # noqa: F401

@@ -1,0 +1,265 @@
+"""BlockRelay: push once-encoded deliver frames down the tree.
+
+The leader's DeliverClient commits a block; the frame comes straight
+off the BlockFanout ring (peer/fanout.py — materialized and encoded
+exactly ONCE, PR 17's contract) and is pushed to this node's current
+tree children over the existing gossip comm senders.  Interior peers
+verify, commit through the GossipStateProvider buffer, and forward
+the SAME frame bytes to their own children — so what lands at every
+peer is byte-identical to a direct orderer pull, at orderer cost
+O(leaders).
+
+Loss tolerance needs no new protocol: a frame dropped anywhere (the
+``dissemination.push`` seam, a bounded child queue overflowing, a
+dead interior peer) leaves a GAP in the receiver's payload buffer,
+and the existing anti-entropy machinery (state.py missing_range ->
+node._pull_range, plus the quiescent-channel pull_tick) repairs it.
+The relay only adds a PROD: a child that just saw a frame BEYOND its
+next needed block knows about the gap now, so it fires the repair
+request immediately instead of waiting out the anti-entropy cadence.
+
+Per-child queues are bounded (``FABRIC_MOD_TPU_RELAY_QUEUE``): a slow
+or dead child sheds its own OLDEST frames, counted, never blocking
+the committing thread or the other children — the dropped range is
+contiguous at the old end, exactly the shape one anti-entropy pull
+repairs.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable, Dict, Optional
+
+from fabric_mod_tpu import faults
+from fabric_mod_tpu.concurrency import (RegisteredLock, RegisteredThread,
+                                        assert_joined)
+from fabric_mod_tpu.observability import tracing
+from fabric_mod_tpu.observability.logging import get_logger
+from fabric_mod_tpu.observability.metrics import (MetricOpts,
+                                                  default_provider)
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.utils import knobs
+
+log = get_logger("dissemination.relay")
+
+_PUSHED = default_provider().new_counter(MetricOpts(
+    "fabric", "relay", "frames_pushed_total",
+    "relay frames sent to tree children", ("channel",)))
+_DROPPED = default_provider().new_counter(MetricOpts(
+    "fabric", "relay", "frames_dropped_total",
+    "relay frames shed (queue overflow / injected push fault)",
+    ("channel",)))
+_REPAIRS = default_provider().new_counter(MetricOpts(
+    "fabric", "relay", "repair_prods_total",
+    "gap-observed anti-entropy prods fired by the relay",
+    ("channel",)))
+
+
+class BlockRelay:
+    """One node's relay engine: root push + interior forward + the
+    gap-repair prod.  `tree_source()` returns the CURRENT RelayTree
+    (recomputed from the live membership view per push, so
+    reparenting needs no callback plumbing)."""
+
+    # sign-once memo: one frame signs ONE envelope reused for every
+    # child (and for the immediate re-forward of a just-received
+    # frame); tiny because pushes are tip-sequential
+    _ENV_MEMO = 8
+
+    def __init__(self, node, tree_source: Callable[[], object],
+                 queue_cap: Optional[int] = None,
+                 on_deliver: Optional[Callable[[int, bytes],
+                                               None]] = None):
+        if queue_cap is None:
+            queue_cap = knobs.get_int("FABRIC_MOD_TPU_RELAY_QUEUE")
+        self._node = node
+        self._tree_source = tree_source
+        self._cap = max(1, int(queue_cap))
+        self._cid = node._channel.channel_id
+        self._lock = RegisteredLock("dissemination.relay._lock")
+        self._ready = threading.Condition(self._lock)
+        self._queues: Dict[str, collections.deque] = {}
+        self._envs: "collections.OrderedDict[int, bytes]" = \
+            collections.OrderedDict()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._fwd_high = -1            # highest num already forwarded
+        self._last_gap_start = -1      # throttles the repair prod
+        self.on_deliver = on_deliver   # (num, frame) tap (bench/tests)
+        self.stats: Dict[str, int] = {
+            "pushed": 0, "forwarded": 0, "received": 0, "dropped": 0,
+            "send_failures": 0, "repair_prods": 0, "duplicates": 0}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = RegisteredThread(target=self._sender_loop,
+                                        name="relay-push",
+                                        structure="dissemination.relay")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            self._ready.notify_all()
+        if self._thread is not None:
+            assert_joined((self._thread,), owner="BlockRelay",
+                          timeout=5)
+            self._thread = None
+
+    def clear(self) -> int:
+        """Demotion/promotion teardown: drop every queued frame (the
+        children's buffers gap and anti-entropy repairs — a torn-down
+        root must not keep pushing a dead stream's tail).  Returns the
+        number of frames discarded."""
+        with self._lock:
+            n = sum(len(q) for q in self._queues.values())
+            self._queues.clear()
+            self._envs.clear()
+        return n
+
+    # -- push (root and interior alike) ------------------------------------
+    def push_frame(self, num: int, frame: bytes,
+                   is_config: bool = False) -> int:
+        """Enqueue one ready frame toward every CURRENT tree child;
+        returns children queued.  Bounded per child: overflow sheds
+        that child's OLDEST frame, counted (never the committing
+        caller's problem)."""
+        children = self._tree_source().children(self._node.endpoint)
+        if not children:
+            return 0
+        queued = 0
+        with self._lock:
+            for child in children:
+                q = self._queues.get(child)
+                if q is None:
+                    q = self._queues[child] = collections.deque()
+                if len(q) >= self._cap:
+                    q.popleft()
+                    self.stats["dropped"] += 1
+                    _DROPPED.with_labels(self._cid).add(1)
+                q.append((num, frame, is_config))
+                queued += 1
+            if queued:
+                self._ready.notify_all()
+        return queued
+
+    def _sender_loop(self) -> None:
+        while not self._stop.is_set():
+            batch = []
+            with self._lock:
+                while not self._stop.is_set():
+                    for child, q in self._queues.items():
+                        if q:
+                            batch.append((child, q.popleft()))
+                    if batch:
+                        break
+                    self._ready.wait(timeout=0.5)
+            if self._stop.is_set():
+                return
+            for child, (num, frame, is_config) in batch:
+                self._send_one(child, num, frame, is_config)
+
+    def _send_one(self, child: str, num: int, frame: bytes,
+                  is_config: bool) -> bool:
+        # the chaos seam: an armed drop loses THIS child's copy on the
+        # wire — the child's buffer gaps and the repair prod + anti-
+        # entropy pull must recover it (what the soak's relay lane and
+        # the gap-repair test assert)
+        if faults.point("dissemination.push"):
+            with self._lock:
+                self.stats["dropped"] += 1
+            _DROPPED.with_labels(self._cid).add(1)
+            return False
+        with tracing.span("relay.push", block=num):
+            env = self._envelope(num, frame, is_config)
+            ok = self._node.comm.send_signed(child, env)
+        with self._lock:
+            self.stats["pushed" if ok else "send_failures"] += 1
+        if ok:
+            _PUSHED.with_labels(self._cid).add(1)
+        return ok
+
+    def _envelope(self, num: int, frame: bytes,
+                  is_config: bool) -> bytes:
+        """Sign once per frame, ship the same envelope to every child
+        (the frame itself was already encoded once on the leader —
+        degree sends must not mean degree signatures either)."""
+        with self._lock:
+            env = self._envs.get(num)
+            if env is not None:
+                return env
+        msg = m.GossipMessage(
+            channel=self._cid.encode(),
+            relay_msg=m.RelayMessage(seq_num=num, frame=frame,
+                                     config=1 if is_config else 0))
+        env = self._node.comm.sign_once(msg)
+        with self._lock:
+            self._envs[num] = env
+            while len(self._envs) > self._ENV_MEMO:
+                self._envs.popitem(last=False)
+        return env
+
+    # -- receive (wired as GossipNode.on_relay) ----------------------------
+    def on_relay(self, msg: m.GossipMessage) -> None:
+        """A frame from our tree parent: verify -> commit through the
+        state buffer -> forward the SAME bytes to our children ->
+        prod repair if the frame revealed a gap."""
+        rm = msg.relay_msg
+        if rm is None or not rm.frame:
+            return
+        if msg.channel != self._cid.encode():
+            return                         # cross-channel guard
+        with self._lock:
+            self.stats["received"] += 1
+        try:
+            resp = m.DeliverResponse.decode(rm.frame)
+            block = resp.block
+            if block is None or block.header is None:
+                return
+            # the same MCS gate every gossip data message passes
+            # BEFORE the state buffer (node._handle_data): a relayed
+            # frame is as untrusted as any gossiped block
+            self._node._channel.mcs.verify_block(self._cid, block)
+        except Exception:
+            return                         # unverifiable: drop, no relay
+        num = rm.seq_num
+        if self.on_deliver is not None:
+            self.on_deliver(num, rm.frame)
+        self._node.state.add_block(block)
+        with self._lock:
+            dup = num <= self._fwd_high
+            if not dup:
+                self._fwd_high = num
+            self.stats["duplicates" if dup else "forwarded"] += 1
+        if not dup:
+            # verbatim forward: children receive the leader's bytes
+            self.push_frame(num, rm.frame, bool(rm.config))
+        self._maybe_repair()
+
+    def _maybe_repair(self) -> None:
+        """A received frame landed BEYOND the next needed block: the
+        gap exists NOW — fire the anti-entropy request immediately
+        instead of waiting out the tick cadence.  Throttled per gap
+        head so a burst of tip frames prods once, not per frame."""
+        gap = self._node.state.buffer.missing_range()
+        if gap is None:
+            with self._lock:
+                self._last_gap_start = -1
+            return
+        with self._lock:
+            if gap.start == self._last_gap_start:
+                return
+            self._last_gap_start = gap.start
+            self.stats["repair_prods"] += 1
+        _REPAIRS.with_labels(self._cid).add(1)
+        with tracing.span("relay.repair", start=gap.start,
+                          stop=gap.stop):
+            # the repair seam: an armed drop suppresses the PROD only
+            # — the periodic anti-entropy tick is the backstop that
+            # must still converge the channel (asserted in tests)
+            if faults.point("dissemination.repair"):
+                return
+            self._node.state.request_gap()

@@ -1,0 +1,144 @@
+"""RelayService: the dissemination layer's binding to leadership.
+
+Wired into GossipService's election transitions (gossip/service.py):
+
+  elected leader   -> sole DeliverClient; each committed block's frame
+                      comes off this service's BlockFanout ring and is
+                      pushed down the tree (``on_leader_commit``)
+  demotion         -> the relay root tears down (queued frames
+                      dropped; whatever the children miss, the
+                      anti-entropy pull repairs)
+  promotion        -> rebuilt from the channel's CURRENT height (a
+                      returning leader relays new commits only — bulk
+                      history is anti-entropy's job, same as the
+                      DeliverClient's resume-from-committed-height)
+
+Non-leaders never see this path's write side: relayed blocks enter
+through ``BlockRelay.on_relay`` -> MCS verify ->
+``GossipStateProvider.add_block`` — the identical in-order buffer +
+commit pipeline every gossiped block already rides, so ordering and
+commit semantics are untouched by the relay.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from fabric_mod_tpu.concurrency.locks import RegisteredLock
+from fabric_mod_tpu.dissemination.relay import BlockRelay
+from fabric_mod_tpu.dissemination.tree import RelayTree
+from fabric_mod_tpu.observability import get_logger
+from fabric_mod_tpu.peer.fanout import BlockFanout, encode_frame
+from fabric_mod_tpu.protos import messages as m
+from fabric_mod_tpu.utils import knobs
+
+log = get_logger("dissemination.service")
+
+
+class RelayService:
+    """One channel's relay composition over a started GossipNode."""
+
+    def __init__(self, node, degree: Optional[int] = None,
+                 queue_cap: Optional[int] = None,
+                 ring_size: Optional[int] = None,
+                 leader_source: Optional[Callable[[], str]] = None,
+                 epoch: int = 0):
+        """`leader_source`: () -> the leader ENDPOINT the tree roots
+        at; the default mirrors the deterministic election (min
+        PKI-ID over {self} ∪ alive), so every peer with a converged
+        view derives the same root the election elects."""
+        self._node = node
+        channel = node._channel
+        self._cid = channel.channel_id
+        if ring_size is None:
+            ring_size = knobs.get_int("FABRIC_MOD_TPU_FANOUT_RING")
+        # the leader's frame source: the SAME bounded ring the deliver
+        # fan-out runs on — one materialize + one encode per block,
+        # shared with any co-located event-deliver engine's semantics
+        self._ring = BlockFanout(self._cid, channel.ledger, "full",
+                                 ring_size)
+        self._degree = degree
+        self._epoch = int(epoch)
+        self._leader_source = leader_source or self._elected_leader
+        self.relay = BlockRelay(node, self.tree, queue_cap=queue_cap)
+        self._lock = RegisteredLock("dissemination.service._lock")
+        self._is_root = False
+        self._root_from = 0
+
+    # -- tree derivation ---------------------------------------------------
+    def _elected_leader(self) -> str:
+        """Deterministic mirror of LeaderElectionService: min PKI-ID
+        over {self} ∪ alive, mapped to its endpoint — agreement comes
+        from the shared membership view, not coordination."""
+        cands = [(self._node.pki_id, self._node.endpoint)]
+        for mb in self._node.discovery.alive_members():
+            cands.append((mb.pki_id, mb.endpoint))
+        return min(cands)[1]
+
+    def tree(self) -> RelayTree:
+        members = [self._node.endpoint] + \
+            [mb.endpoint for mb in self._node.discovery.alive_members()]
+        return RelayTree(members, self._leader_source(),
+                         epoch=self._epoch, degree=self._degree)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._node.on_relay = self.relay.on_relay
+        self.relay.start()
+
+    def stop(self) -> None:
+        self.relay.stop()
+        if self._node.on_relay == self.relay.on_relay:
+            self._node.on_relay = None
+
+    # -- leadership transitions (driven by GossipService) ------------------
+    def on_leadership(self, is_leader: bool) -> None:
+        with self._lock:
+            was, self._is_root = self._is_root, bool(is_leader)
+        if is_leader and not was:
+            self.promote()
+        elif was and not is_leader:
+            self.demote()
+
+    def promote(self) -> None:
+        """Rebuild the relay root from the channel's CURRENT height:
+        a returning leader pushes new commits; anything a peer is
+        missing below that is a gap its anti-entropy already knows
+        how to pull."""
+        self._root_from = self._node._channel.ledger.height
+        self.relay.clear()
+        log.info("%s: relay root up from height %d",
+                 self._node.endpoint, self._root_from)
+
+    def demote(self) -> None:
+        dropped = self.relay.clear()
+        log.info("%s: relay root torn down (%d queued frames dropped)",
+                 self._node.endpoint, dropped)
+
+    # -- the leader's commit hook (DeliverClient on_commit) ----------------
+    def on_leader_commit(self, block: m.Block) -> None:
+        """Frame the committed block off the fan-out ring and push it
+        down the tree.  Replaces the leader's epidemic gossip_block:
+        every peer is a tree member, so coverage comes from the
+        forest, loss repair from anti-entropy."""
+        with self._lock:
+            if not self._is_root:
+                return                     # demoted mid-callback
+        num = block.header.number
+        fr = self._ring.get(num)
+        if fr is not None:
+            self.relay.push_frame(fr.num, fr.payload, fr.is_config)
+            return
+        # commit signaled but the ledger read raced it (async commit
+        # pipe edge): encode from the in-hand block — same bytes, the
+        # ring picks the window up on the next commit
+        self.relay.push_frame(
+            num, encode_frame(self._cid, "full", block))
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def stats(self) -> Dict[str, int]:
+        return self.relay.stats
+
+    @property
+    def ring_stats(self) -> Dict[str, int]:
+        return self._ring.stats

@@ -1,0 +1,118 @@
+"""RelayTree: the dissemination tree as a pure function.
+
+Every peer computes the tree from exactly three inputs — the sorted
+alive-membership snapshot, the elected leader, and an epoch — so all
+peers with a converged membership view derive the IDENTICAL tree with
+zero coordination messages (the same trick the deterministic
+min-PKI-ID election plays for leadership: agreement falls out of a
+shared view plus a shared pure function, reference:
+gossip/election/election.go's converged-view computation).
+
+Layout: the BFS array ``[leader] + rotate(sorted(others), epoch)``
+with fan-out degree d — node at index i parents indices
+``d*i+1 .. d*i+d``.  The epoch rotation re-deals interior positions
+across epochs so relay load does not pin to the lexicographically
+smallest endpoints forever.
+
+Reparenting is the same pure function over the shrunken membership:
+``tree.without(dead)`` is what every survivor independently computes
+when discovery expires a member, and :func:`reparent_plan` names
+exactly which members moved (the soak's relay lane asserts recovery
+after such a move).  A dead LEADER is the election's job — `without`
+falls back to the deterministic minimum of the survivors, mirroring
+what the election converges to.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from fabric_mod_tpu.utils import knobs
+
+
+class RelayTree:
+    """One channel's relay tree over opaque, orderable member ids
+    (gossip endpoints in production)."""
+
+    __slots__ = ("leader", "epoch", "degree", "order", "_index")
+
+    def __init__(self, members: Iterable[str], leader: str,
+                 epoch: int = 0, degree: Optional[int] = None):
+        if degree is None:
+            degree = knobs.get_int("FABRIC_MOD_TPU_RELAY_DEGREE")
+        self.degree = max(1, int(degree))
+        self.leader = leader
+        self.epoch = int(epoch)
+        others = sorted(mm for mm in set(members) if mm != leader)
+        if others:
+            r = self.epoch % len(others)
+            others = others[r:] + others[:r]
+        self.order: Tuple[str, ...] = (leader, *others)
+        self._index: Dict[str, int] = {mm: i for i, mm
+                                       in enumerate(self.order)}
+
+    # -- pure queries ------------------------------------------------------
+    def __contains__(self, member: str) -> bool:
+        return member in self._index
+
+    def __len__(self) -> int:
+        return len(self.order)
+
+    def children(self, member: str) -> List[str]:
+        """The members `member` pushes frames to ([] for leaves and
+        for members outside the tree — a peer whose view has not
+        converged yet simply relays to nobody rather than guessing)."""
+        i = self._index.get(member)
+        if i is None:
+            return []
+        lo = i * self.degree + 1
+        return list(self.order[lo:lo + self.degree])
+
+    def parent(self, member: str) -> Optional[str]:
+        i = self._index.get(member)
+        if i is None or i == 0:
+            return None
+        return self.order[(i - 1) // self.degree]
+
+    def depth(self, member: str) -> int:
+        """Hops from the leader (-1 for a non-member)."""
+        i = self._index.get(member)
+        if i is None:
+            return -1
+        d = 0
+        while i > 0:
+            i = (i - 1) // self.degree
+            d += 1
+        return d
+
+    # -- reparenting -------------------------------------------------------
+    def without(self, dead: str) -> "RelayTree":
+        """The tree every survivor derives once `dead` expires from
+        the membership view.  Same leader/epoch/degree — unless the
+        leader itself died, in which case the deterministic minimum of
+        the survivors roots the new tree (the value the min-PKI
+        election converges to, modulo the id space)."""
+        members = [mm for mm in self.order if mm != dead]
+        leader = self.leader
+        if dead == leader:
+            leader = min(members) if members else ""
+        return RelayTree(members, leader, epoch=self.epoch,
+                         degree=self.degree)
+
+
+def reparent_plan(old: RelayTree,
+                  new: RelayTree) -> Dict[str, Tuple[Optional[str],
+                                                     Optional[str]]]:
+    """member -> (old_parent, new_parent) for every member present in
+    both trees whose parent changed — the exact set of peers that must
+    start accepting frames from a new upstream after a membership
+    change (pure bookkeeping: the relay needs no handshake, because
+    frames are self-describing and commits are gated by the state
+    buffer either way)."""
+    plan: Dict[str, Tuple[Optional[str], Optional[str]]] = {}
+    for member in new.order:
+        if member not in old:
+            continue
+        was, now = old.parent(member), new.parent(member)
+        if was != now:
+            plan[member] = (was, now)
+    return plan

@@ -30,6 +30,8 @@ DECLARED_POINTS: Set[str] = {
     "deliver.failover.stream",
     "deliver.fanout",
     "deliver.stream",
+    "dissemination.push",
+    "dissemination.repair",
     "gossip.comm.drop",
     "gossip.comm.send",
     "orderer.admission.overload",

@@ -523,6 +523,19 @@ class GossipComm:
         return self._network.send(self.endpoint, self.pki_id,
                                   dst_endpoint, env.encode())
 
+    def sign_once(self, msg: m.GossipMessage) -> bytes:
+        """Pre-sign a message into its envelope bytes.  The relay's
+        push signs each frame ONE time and ships the identical
+        envelope to every tree child — degree sends must not mean
+        degree signatures (the frame was likewise encoded once)."""
+        from fabric_mod_tpu.gossip.protoext import sign_message
+        return sign_message(msg, self._signer).encode()
+
+    def send_signed(self, dst_endpoint: str, env_bytes: bytes) -> bool:
+        """Ship pre-signed envelope bytes (from sign_once)."""
+        return self._network.send(self.endpoint, self.pki_id,
+                                  dst_endpoint, env_bytes)
+
     def broadcast(self, dst_endpoints, msg: m.GossipMessage) -> int:
         got = 0
         for dst in dst_endpoints:

@@ -64,6 +64,11 @@ class GossipNode:
         # seen moments earlier the way the old FIFO cap could
         from fabric_mod_tpu.gossip.msgstore import TTLMessageStore
         self._seen = TTLMessageStore(ttl_s=120.0)
+        # the dissemination layer's receive hook (RelayService wires
+        # BlockRelay.on_relay here); relay frames are dropped until a
+        # relay is composed — a relay-less peer still converges via
+        # the push epidemic + anti-entropy
+        self.on_relay: Optional[Callable[[m.GossipMessage], None]] = None
         network.register(endpoint, self.on_message)
 
     # -- outbound ---------------------------------------------------------
@@ -130,6 +135,10 @@ class GossipNode:
             self._handle_pvt_request(src_pki_id, msg)
         elif msg.pvt_resp is not None:
             self._handle_pvt_response(msg)
+        elif msg.relay_msg is not None:
+            handler = self.on_relay
+            if handler is not None:
+                handler(msg)
 
     def _verify_with_carried_identity(self, env, payload, sig) -> bool:
         """Bootstrap: an alive message carries its own identity —

@@ -42,12 +42,16 @@ class GossipService:
 
     def __init__(self, node, deliver_source_factory: Callable[[], object],
                  static_leader: Optional[bool] = None,
-                 election_interval_s: float = 0.5):
+                 election_interval_s: float = 0.5,
+                 relay=None):
         """`node`: a started GossipNode.  `deliver_source_factory`:
         () -> a deliver source (FailoverDeliverSource in production,
         the in-process DeliverService in tests); called fresh on every
         promotion so a returning leader re-dials.  `static_leader`
-        pins leadership (reference: the static org-leader mode)."""
+        pins leadership (reference: the static org-leader mode).
+        `relay`: a dissemination.RelayService replacing the epidemic
+        push with tree-structured frame relay; auto-built when the
+        FABRIC_MOD_TPU_RELAY knob is set."""
         self._node = node
         self._factory = deliver_source_factory
         self._interval = election_interval_s
@@ -55,11 +59,22 @@ class GossipService:
         self._client_thread: Optional[threading.Thread] = None
         self._client_halt: Optional[threading.Event] = None
         self._lock = RegisteredLock("gossip.service._lock")
+        if relay is None:
+            from fabric_mod_tpu.utils import knobs
+            if knobs.get_bool("FABRIC_MOD_TPU_RELAY"):
+                from fabric_mod_tpu.dissemination import RelayService
+                relay = RelayService(node)
+        self._relay = relay
         self.election = LeaderElectionService(
             node.pki_id,
             lambda: [mb.pki_id for mb in node.discovery.alive_members()],
             on_change=self._on_leadership,
             static=static_leader)
+
+    @property
+    def relay(self):
+        """The dissemination RelayService, if composed (else None)."""
+        return self._relay
 
     @property
     def is_leader(self) -> bool:
@@ -70,6 +85,11 @@ class GossipService:
         # NON-leader's gossip receipts into commits — the service owns
         # it so every composed peer commits regardless of leadership
         self._node.state.start()
+        if self._relay is not None:
+            # hooks node.on_relay + spawns the push thread BEFORE any
+            # leadership verdict: an interior peer must already accept
+            # relayed frames when the root starts pushing
+            self._relay.start()
         # immediate first verdict BEFORE the loop spawns: once the
         # election loop runs, it owns ticking (concurrency.ThreadOwnership
         # — an external tick racing the loop can deliver on_change
@@ -80,31 +100,48 @@ class GossipService:
         # the static-leader path never fires on_change (leadership is
         # fixed from construction) — start the client directly
         if self.election.is_leader:
+            if self._relay is not None:
+                self._relay.on_leadership(True)
             self._start_client()
 
     def stop(self) -> None:
         self.election.stop()
         self._stop_client()
+        if self._relay is not None:
+            self._relay.stop()
         self._node.state.stop()
 
     # -- leadership transitions -------------------------------------------
     def _on_leadership(self, is_leader: bool) -> None:
         if is_leader:
             log.info("%s: elected deliver leader", self._node.endpoint)
+            if self._relay is not None:
+                # promote BEFORE the client starts: the first commit's
+                # on_leader_commit must find the relay rooted, or the
+                # leading edge of the stream never enters the tree
+                self._relay.on_leadership(True)
             self._start_client()
         else:
             log.info("%s: demoted from deliver leadership",
                      self._node.endpoint)
             self._stop_client()
+            if self._relay is not None:
+                self._relay.on_leadership(False)
 
     def _start_client(self) -> None:
         with self._lock:
             if self._client is not None:
                 return
             channel = self._node._channel
+            # with a relay composed, the leader's committed blocks feed
+            # the dissemination tree (encoded once off the fanout ring)
+            # instead of the sqrt-N epidemic push
+            on_commit = (self._relay.on_leader_commit
+                         if self._relay is not None
+                         else self._node.gossip_block)
             client = DeliverClient(
                 channel, self._factory(),
-                on_commit=self._node.gossip_block)
+                on_commit=on_commit)
             self._client = client
             halt = threading.Event()
             self._client_halt = halt

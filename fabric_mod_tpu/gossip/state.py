@@ -196,6 +196,18 @@ class GossipStateProvider:
             return True
         return pipe.flush(timeout_s)
 
+    def request_gap(self) -> Optional[range]:
+        """Immediately request the gap blocking progress, if any.
+        The relay's repair prod: a child that just SAW a frame beyond
+        its next needed block knows the gap exists NOW — waiting out
+        the anti-entropy cadence would add a full interval to every
+        relay drop's repair latency.  The periodic tick below remains
+        the backstop for gaps nobody observed."""
+        gap = self.buffer.missing_range()
+        if gap is not None and self._request_missing is not None:
+            self._request_missing(gap)
+        return gap
+
     def anti_entropy_tick(self) -> Optional[range]:
         """If a gap blocks progress, ask for it
         (reference: the anti-entropy goroutine).  Also detects an

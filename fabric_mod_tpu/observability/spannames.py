@@ -34,6 +34,8 @@ DECLARED_SPANS: Set[str] = {
     "policy_gather",
     "raft.replicate",
     "recv",
+    "relay.push",
+    "relay.repair",
     "shard.dispatch",
     "unpack",
     "verdict_await",

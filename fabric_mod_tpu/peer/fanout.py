@@ -221,7 +221,7 @@ class BlockFanout:
     """The bounded ring of ready frames for one (channel, form)."""
 
     def __init__(self, channel_id: str, ledger, form: str,
-                 ring_size: int, stats: Dict[str, int],
+                 ring_size: int, stats: Optional[Dict[str, int]] = None,
                  classify: Optional[Callable[[m.Block], bool]] = None):
         self._channel_id = channel_id
         self._ledger = ledger
@@ -230,7 +230,11 @@ class BlockFanout:
         self._ring: Dict[int, _Frame] = {}
         self._lock = RegisteredLock(f"peer.fanout.{form}._lock")
         self._classify = classify or _is_config_block
-        self.stats = stats
+        # standalone consumers (the dissemination relay rides a bare
+        # ring with no engine around it) get their own counters
+        self.stats = stats if stats is not None else {
+            "materialized": 0, "encoded": 0, "ring_hits": 0,
+            "fallbacks": 0}
         self._m_mat = _metric("counter", "fanout_materialize_total",
                               "blocks materialized once into the ring")
         self._m_enc = _metric("counter", "fanout_encode_total",

@@ -1006,6 +1006,21 @@ class PvtDataResponse(Msg):
 
 
 @message
+class RelayMessage(Msg):
+    """One relayed deliver frame: the leader's once-encoded
+    DeliverResponse bytes pushed down the dissemination tree verbatim
+    (dissemination/relay.py) — a receiving peer forwards the SAME
+    bytes to its children, so every hop ships what a direct orderer
+    pull would have returned."""
+    FIELDS = ((1, "seq_num", "u"), (2, "frame", "b"), (3, "config", "u"),
+              (4, "epoch", "u"))
+    seq_num: int = 0            # block number
+    frame: bytes = b""          # DeliverResponse wire bytes
+    config: int = 0             # carries a channel config tx
+    epoch: int = 0              # sender's tree epoch
+
+
+@message
 class GossipMessage(Msg):
     # oneof payload: alive/data/hello/digest/request/update/private
     FIELDS = ((1, "nonce", "u"), (2, "channel", "b"), (3, "tag", "i"),
@@ -1017,7 +1032,8 @@ class GossipMessage(Msg):
               (10, "data_update", ("m", "DataUpdate")),
               (11, "private_data", ("m", "PvtDataElement")),
               (12, "pvt_req", ("m", "PvtDataRequest")),
-              (13, "pvt_resp", ("m", "PvtDataResponse")))
+              (13, "pvt_resp", ("m", "PvtDataResponse")),
+              (14, "relay_msg", ("m", "RelayMessage")))
     nonce: int = 0
     channel: bytes = b""
     tag: int = 0
@@ -1030,6 +1046,7 @@ class GossipMessage(Msg):
     private_data: Optional["PvtDataElement"] = None
     pvt_req: Optional[PvtDataRequest] = None
     pvt_resp: Optional[PvtDataResponse] = None
+    relay_msg: Optional[RelayMessage] = None
 
 
 @message

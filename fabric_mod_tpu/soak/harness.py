@@ -24,6 +24,12 @@ Knobs (all env-overridable, the FMT_SOAK_* table in README):
   FMT_SOAK_X509_GAP_S     x509 lane inter-tx gap       (default 0.12)
   FMT_SOAK_IDEMIX_GAP_S   idemix lane inter-tx gap     (default 1.0)
   FMT_SOAK_FAULT_P        background fault probability (default 0.05)
+  FMT_SOAK_RELAY          1 = dissemination-relay mode: blocks ship
+                          down RelayTrees instead of epidemic push;
+                          leader_kill additionally partitions the
+                          relay root (recovery recorded under
+                          kind=relay_reparent), and the run fails if
+                          the relay never carried a block
 """
 from __future__ import annotations
 
@@ -168,6 +174,14 @@ class SoakHarness:
                         f"{world.channel_ids[0]}", self.plan)
             ctx["orderer"] = victim
             world.kill_orderer(victim)
+            if world.relay:
+                # relay-mode amplifier: cut the gossip relay ROOT off
+                # the channel too — survivors must expire it, elect a
+                # new root, and reparent the tree while the raft layer
+                # is itself electing; the victim (still leader in its
+                # own view) converges through its own deliver client
+                ctx["relay_root"] = world.partition_relay_leader(
+                    world.channel_ids[0])
         else:                              # pragma: no cover
             raise SoakError(f"unknown event kind {kind!r}", self.plan)
         log.info("soak: fired %s %s", kind, ctx)
@@ -288,6 +302,16 @@ class SoakHarness:
                     ctx["post_rate"] = round(post_rate, 2)
                     rates.append(post_rate)
                     self._post_event(world, checker, ctx)
+                    if ctx.get("relay_root") is not None:
+                        # heal the partitioned root: the returning
+                        # minimum reclaims leadership and the tree
+                        # reparents AGAIN — that second transition is
+                        # the recorded relay_reparent recovery
+                        world.heal_relay_leader(world.channel_ids[0],
+                                                ctx["relay_root"])
+                        ctx["relay_reparent_s"] = round(
+                            checker.check_converged("relay_reparent"),
+                            3)
                     checker.check_lanes()
                     events_report.append(ctx)
                 # tail: stop lanes, settle, audit the whole run
@@ -300,6 +324,16 @@ class SoakHarness:
                         "background fault plan never fired — the "
                         "chaos rider is disconnected from its "
                         "injection points", self.plan)
+                relay_report = None
+                if world.relay:
+                    relay_report = world.relay_stats()
+                    if relay_report.get("received", 0) == 0:
+                        raise SoakError(
+                            "FMT_SOAK_RELAY: the dissemination relay "
+                            "never carried a block — every peer "
+                            "converged via fallback paths only, so "
+                            "the tree under test did nothing",
+                            self.plan)
         except SoakError:
             raise
         except Exception as e:
@@ -336,7 +370,11 @@ class SoakHarness:
             "channels": world.channel_ids,
             # FMT_SOAK_SHARDED: churn rode the per-peer shard routers
             "sharded": world.sharded,
+            # FMT_SOAK_RELAY: blocks rode dissemination trees
+            "relay_mode": world.relay,
         }
+        if relay_report is not None:
+            report["relay"] = relay_report
         if trace_t0 is not None:
             # commit-path stage attribution across the whole run (the
             # FMT_TRACE sub-span totals accumulated since t_start)

@@ -175,9 +175,13 @@ class SoakPeer:
                               world.networks[cid],
                               rng=_seeded_rng(world.seed, name, cid))
             self.nodes[cid] = node
+            relay = None
+            if world.relay:
+                from fabric_mod_tpu.dissemination import RelayService
+                relay = RelayService(node)
             self.services[cid] = GossipService(
                 node, lambda cid=cid: _FailoverSource(world, cid),
-                election_interval_s=0.2)
+                election_interval_s=0.2, relay=relay)
 
     def height(self, cid: str) -> int:
         return self.channels[cid].ledger.height
@@ -250,6 +254,11 @@ class SoakWorld:
         self.csp = SwCSP()
         from fabric_mod_tpu.utils import knobs as _knobs
         self.sharded = _knobs.get_bool("FMT_SOAK_SHARDED")
+        # opt-in dissemination-relay mode (FMT_SOAK_RELAY): every
+        # peer's channels ship blocks down RelayTrees instead of the
+        # sqrt-N epidemic push, so churn exercises reparenting and the
+        # anti-entropy repair seam instead of redundant push paths
+        self.relay = _knobs.get_bool("FMT_SOAK_RELAY")
         self.orgs = list(orgs)
         self.channel_ids = [f"soak{i}" for i in range(n_channels)]
         self.clock = ManualClock()
@@ -593,6 +602,89 @@ class SoakWorld:
         peer.start()
         log.info("soak: peer %s joined (org %s)", peer.name, org)
         return peer
+
+    # -- dissemination relay (FMT_SOAK_RELAY) ------------------------------
+
+    def gossip_leader(self, cid: str) -> Optional[str]:
+        """The peer currently holding GOSSIP deliver leadership on a
+        channel (distinct from the raft orderer leader)."""
+        for p in self.peers:
+            if p.services[cid].is_leader:
+                return p.name
+        return None
+
+    def relay_stats(self) -> Dict[str, int]:
+        """Aggregate BlockRelay counters across every peer/channel —
+        the run-end proof that the tree actually carried blocks."""
+        agg: Dict[str, int] = {}
+        for p in self.peers:
+            for svc in p.services.values():
+                relay = getattr(svc, "relay", None)
+                if relay is None:
+                    continue
+                for k, v in relay.stats.items():
+                    agg[k] = agg.get(k, 0) + v
+        return agg
+
+    def partition_relay_leader(self, cid: str,
+                               timeout_s: float = 20.0) -> str:
+        """Cut the gossip relay ROOT off the channel's gossip network
+        (the relay-mode churn amplifier riding leader_kill): survivors
+        must expire it, elect a new root, and rebuild the tree.  The
+        victim keeps its own DeliverClient and converges alone.
+        Discovery is never background-ticked in the soak, so this
+        drives the alive/expiry rounds itself under a temporarily
+        tightened expiry.  Returns the victim peer's name."""
+        victim = None
+        deadline = time.monotonic() + timeout_s
+        while victim is None:
+            name = self.gossip_leader(cid)
+            victim = next((p for p in self.peers if p.name == name),
+                          None)
+            if victim is None:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"no gossip leader to partition on {cid}")
+                time.sleep(0.05)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
+        ep = victim.nodes[cid].endpoint
+        self.networks[cid].partitioned.add(ep)
+        log.info("soak: partitioned relay root %s (%s)", victim.name, ep)
+        survivors = [p for p in self.peers if p is not victim]
+        saved = {p.name: p.nodes[cid].discovery.expiry_s
+                 for p in survivors}
+        for p in survivors:
+            p.nodes[cid].discovery.expiry_s = 0.6
+        try:
+            while time.monotonic() < deadline:
+                gone = True
+                for p in survivors:
+                    d = p.nodes[cid].discovery
+                    d.tick_send_alive()
+                    d.tick_check_alive()
+                    if ep in d.alive_endpoints():
+                        gone = False
+                if gone:
+                    return victim.name
+                time.sleep(0.15)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
+        finally:
+            for p in survivors:
+                p.nodes[cid].discovery.expiry_s = saved[p.name]
+        raise RuntimeError(
+            f"partitioned relay root {ep} never expired from the "
+            f"survivors' membership views on {cid}")
+
+    def heal_relay_leader(self, cid: str, peer_name: str) -> None:
+        """Reconnect a partitioned relay root: membership re-forms
+        over a few alive rounds and the election re-converges (another
+        reparent — the returning minimum reclaims the root)."""
+        peer = next(p for p in self.peers if p.name == peer_name)
+        self.networks[cid].partitioned.discard(
+            peer.nodes[cid].endpoint)
+        for _ in range(3):
+            for p in self.peers:
+                p.nodes[cid].discovery.tick_send_alive()
+            time.sleep(0.05)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
+        log.info("soak: healed relay root %s on %s", peer_name, cid)
 
     # -- lifecycle ---------------------------------------------------------
 

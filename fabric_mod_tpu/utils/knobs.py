@@ -167,6 +167,12 @@ declare("FMT_SOAK_SHARDED", "bool", None,
         "ChannelShardRouter (host-mode slices + the shared "
         "cross-channel verify service) so churn rides the sharding "
         "subsystem")
+declare("FMT_SOAK_RELAY", "bool", None,
+        "1 runs every soak peer's channels in relay mode "
+        "(dissemination/ trees instead of epidemic push): churn "
+        "exercises reparenting + anti-entropy repair, and leader_kill "
+        "additionally flaps the relay root (recovery recorded as "
+        "kind=relay_reparent)")
 
 # -- device / kernel routing ------------------------------------------------
 declare("FABRIC_MOD_TPU_MIXED_ADD", "bool", None,
@@ -281,6 +287,18 @@ declare("FABRIC_MOD_TPU_FANOUT_RING", "int", 128,
         "per-(channel, form) deliver fan-out ring depth: blocks kept "
         "as ready-to-send frames; subscribers lagging past the tail "
         "fall back to a counted per-stream ledger re-read")
+
+# -- cross-peer dissemination ----------------------------------------------
+declare("FABRIC_MOD_TPU_RELAY", "bool", None,
+        "1 builds a RelayService into every GossipService: the "
+        "elected leader keeps the sole orderer pull and pushes "
+        "once-encoded frames down the deterministic relay tree; "
+        "unset = the epidemic gossip_block push")
+declare("FABRIC_MOD_TPU_RELAY_DEGREE", "int", 4,
+        "relay-tree fan-out degree: children each member pushes to")
+declare("FABRIC_MOD_TPU_RELAY_QUEUE", "int", 64,
+        "per-child relay queue bound; a slow child sheds its own "
+        "OLDEST frames, counted (anti-entropy repairs the gap)")
 
 # -- retries / gossip -------------------------------------------------------
 declare("FABRIC_MOD_TPU_RETRY_BASE_S", "float", 0.05,

@@ -134,6 +134,21 @@ MATRIX = [
     # once-per-(group, key) session ACLs before blocks*subs/s lands
     ("deliverfanout_10k", ["--metric", "deliverfanout",
                            "--subscribers", "10000"], {}, 1200),
+    # host-only deliver fan-out at the 100k-subscriber top point,
+    # slow-marked as its own entry (the default smoke sweep stops at
+    # 10k): the top point's chain is read back from a RELAYED
+    # non-leader peer's ledger — the fan-out engine provably composes
+    # with the dissemination tree path — and the byte-identity +
+    # once-per-(block, form) + session-ACL gates run unchanged
+    ("deliverfanout_100k", ["--metric", "deliverfanout",
+                            "--subscribers", "100000"], {}, 2400),
+    # host-only dissemination forest: relay-vs-all-pull at 8/32/128
+    # peers over the live signed gossip comm layer; every point gates
+    # relayed-frame byte-identity (== a direct orderer pull's bytes),
+    # all-peer state-fingerprint convergence, and exactly ONE orderer
+    # deliver stream per leader before blocks*peers/s lands
+    ("dissemination_128peer", ["--metric", "dissemination",
+                               "--peers", "128"], {}, 1800),
     # host-only vectorized-MVCC state-scale sweep: the same signed
     # stream committed into ledgers prefilled at 10k/100k/1M keys,
     # generic vs FABRIC_MOD_TPU_VECTOR_MVCC arms; per-point txflags +

@@ -148,6 +148,19 @@ FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
 FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
     -p no:cacheprovider -p no:randomly -m 'not slow' \
     tests/test_vectormvcc.py
+# 0k. the dissemination slice, FMT_RACECHECK=1: RelayTree determinism
+#     + reparent-plan units, the 5-peer relay world's frame
+#     byte-identity (relayed bytes == a direct orderer pull's) +
+#     single-deliver-stream + state-fingerprint convergence, the
+#     bounded per-child queue shedding counted-not-lost, gap repair
+#     under an armed dissemination.push drop (repair prod ->
+#     anti-entropy pull), and the leadership flap (old root torn
+#     down, new root relays from its current height) — the relay
+#     push thread and every forwarding peer run with the race guards
+#     armed from the day the subsystem lands
+FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
+    -p no:cacheprovider -p no:randomly -m 'not slow' \
+    tests/test_dissemination.py
 # vectorized-armed commitpipe differential: the whole pipelined/sync/
 # depth1/traced gate set re-run with FABRIC_MOD_TPU_VECTOR_MVCC hot,
 # so the columnar MVCC path is proven inside the real commit pipeline

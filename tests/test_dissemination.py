@@ -1,0 +1,361 @@
+"""Cross-peer dissemination trees: determinism, byte identity,
+bounded queues, gap repair, and leadership flaps.
+
+(reference behavior model: the gossip push epidemic's guarantees —
+every peer converges to the leader's pulled stream — delivered at
+tree cost: the leader pushes degree frames, interior peers forward,
+and any loss is a payload-buffer gap the existing anti-entropy pull
+already repairs.)
+"""
+import time
+import types
+
+import pytest
+
+from fabric_mod_tpu import faults
+from fabric_mod_tpu.bccsp.tpu import FakeBatchVerifier
+from fabric_mod_tpu.channelconfig import Bundle
+from fabric_mod_tpu.channelconfig.configtx import config_from_block
+from fabric_mod_tpu.dissemination import (BlockRelay, RelayService,
+                                          RelayTree, reparent_plan)
+from fabric_mod_tpu.e2e import Network
+from fabric_mod_tpu.gossip import GossipNode, GossipService, InProcNetwork
+from fabric_mod_tpu.ledger.kvledger import LedgerManager
+from fabric_mod_tpu.msp import ca as calib
+from fabric_mod_tpu.msp.identities import SigningIdentity
+from fabric_mod_tpu.orderer import DeliverService
+from fabric_mod_tpu.peer.channel import Channel
+from fabric_mod_tpu.peer.fanout import encode_frame
+
+
+def _wait(pred, t=25.0):
+    deadline = time.time() + t
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# RelayTree: the pure function
+# ---------------------------------------------------------------------------
+
+def test_tree_deterministic_regardless_of_member_order():
+    members = [f"p{i}:7051" for i in range(13)]
+    import random
+    trees = []
+    for seed in range(5):
+        shuffled = list(members)
+        random.Random(seed).shuffle(shuffled)
+        trees.append(RelayTree(shuffled, leader="p7:7051", epoch=3,
+                               degree=3))
+    for t in trees[1:]:
+        assert t.order == trees[0].order
+    t = trees[0]
+    assert t.order[0] == "p7:7051"
+    assert len(t) == 13
+    # every member has exactly one parent (except the root), and
+    # parent/children agree
+    seen = set()
+    for mm in t.order:
+        for c in t.children(mm):
+            assert t.parent(c) == mm
+            assert c not in seen
+            seen.add(c)
+    assert seen == set(members) - {"p7:7051"}
+    # depth is parent depth + 1
+    for mm in t.order[1:]:
+        assert t.depth(mm) == t.depth(t.parent(mm)) + 1
+    assert t.depth("p7:7051") == 0
+    assert t.depth("not-a-member") == -1
+    assert t.children("not-a-member") == []
+
+
+def test_tree_epoch_rotation_moves_interior_load():
+    members = [f"p{i}" for i in range(9)]
+    t0 = RelayTree(members, leader="p0", epoch=0, degree=2)
+    t1 = RelayTree(members, leader="p0", epoch=1, degree=2)
+    assert t0.order[0] == t1.order[0] == "p0"
+    assert t0.order != t1.order          # interior positions re-dealt
+    assert set(t0.order) == set(t1.order)
+
+
+def test_reparent_plan_names_exactly_the_moved_members():
+    members = [f"p{i}" for i in range(9)]
+    t0 = RelayTree(members, leader="p0", epoch=0, degree=2)
+    dead = t0.children("p0")[0]          # an interior member dies
+    t1 = t0.without(dead)
+    assert dead not in t1
+    plan = reparent_plan(t0, t1)
+    assert plan                          # someone must have moved
+    for member, (was, now) in plan.items():
+        assert was != now
+        assert t0.parent(member) == was
+        assert t1.parent(member) == now
+    # members whose parent is unchanged are NOT in the plan
+    for member in t1.order:
+        if member not in plan:
+            assert t0.parent(member) == t1.parent(member)
+
+
+def test_reparent_dead_leader_falls_to_deterministic_minimum():
+    members = [f"p{i}" for i in range(5)]
+    t0 = RelayTree(members, leader="p3", epoch=0, degree=2)
+    t1 = t0.without("p3")
+    assert t1.leader == "p0" == t1.order[0]
+    assert "p3" not in t1
+    assert len(t1) == 4
+
+
+# ---------------------------------------------------------------------------
+# BlockRelay units: bounded queues
+# ---------------------------------------------------------------------------
+
+def _fake_node(endpoint="root:7051", cid="ch"):
+    return types.SimpleNamespace(
+        endpoint=endpoint,
+        _channel=types.SimpleNamespace(channel_id=cid),
+        comm=None, state=None)
+
+
+def test_child_queue_overflow_sheds_oldest_counted():
+    tree = RelayTree(["root:7051", "a:7051", "b:7051"],
+                     leader="root:7051", degree=2)
+    relay = BlockRelay(_fake_node(), lambda: tree, queue_cap=2)
+    # never started: frames pile up per child and the cap must shed
+    for num in range(5):
+        assert relay.push_frame(num, b"frame%d" % num) == 2
+    # each child kept the NEWEST 2, shed the oldest 3 — contiguous at
+    # the old end, the exact shape one anti-entropy pull repairs
+    assert relay.stats["dropped"] == 6    # 3 shed x 2 children
+    with relay._lock:
+        for child in ("a:7051", "b:7051"):
+            kept = [num for num, _, _ in relay._queues[child]]
+            assert kept == [3, 4]
+    assert relay.clear() == 4
+    assert relay.push_frame(9, b"f") == 2  # usable after clear
+
+
+def test_push_to_nobody_is_free():
+    tree = RelayTree(["leaf:7051", "root:7051"], leader="root:7051")
+    relay = BlockRelay(_fake_node("leaf:7051"), lambda: tree,
+                       queue_cap=4)
+    assert relay.push_frame(1, b"x") == 0  # leaves relay to nobody
+    assert relay.stats["dropped"] == 0
+
+
+# ---------------------------------------------------------------------------
+# The wired world: relay-mode GossipServices over a real orderer
+# ---------------------------------------------------------------------------
+
+N_PEERS = 5
+
+
+@pytest.fixture()
+def relay_world(tmp_path):
+    """Orderer-backed Network + 5 relay-mode gossiping peers (tree
+    degree 2, so interior FORWARDING is exercised, not just root
+    push), with a per-peer tap of every relayed frame."""
+    net = Network(str(tmp_path), batch_timeout="100ms",
+                  max_message_count=10)
+    fabric = InProcNetwork()
+    _, config = config_from_block(net.genesis_block)
+    mgrs, peers, services, taps = [], [], [], []
+    orgs = ("Org1", "Org2", "Org3")
+    for i in range(N_PEERS):
+        org = orgs[i % len(orgs)]
+        csp = net.csp
+        bundle = Bundle(net.channel_id, config, csp)
+        mgr = LedgerManager(str(tmp_path / f"peer{i}"))
+        mgrs.append(mgr)
+        ledger = mgr.create_or_open(net.channel_id)
+        channel = Channel(net.channel_id, ledger,
+                          FakeBatchVerifier(csp), bundle, csp)
+        if ledger.height == 0:
+            channel.init_from_genesis(net.genesis_block)
+        cert, key = net.cas[org].issue(f"dsm{i}.{org.lower()}", org,
+                                      ous=["peer"])
+        signer = SigningIdentity(org, cert, calib.key_pem(key), csp)
+        node = GossipNode(f"dsm{i}:7051", signer, channel, fabric)
+        relay = RelayService(node, degree=2)
+        tap = []
+        relay.relay.on_deliver = \
+            lambda num, frame, acc=tap: acc.append((num, frame))
+        svc = GossipService(
+            node, lambda: DeliverService(net.support),
+            election_interval_s=0.2, relay=relay)
+        peers.append(node)
+        services.append(svc)
+        taps.append(tap)
+    eps = [p.endpoint for p in peers]
+    for p in peers:
+        p.join(eps)
+    for _ in range(2):
+        for p in peers:
+            p.discovery.tick_send_alive()
+    for s in services:
+        s.start()
+    yield net, fabric, peers, services, taps
+    for s in services:
+        s.stop()
+    for p in peers:
+        p.stop()
+    for mg in mgrs:
+        mg.close()
+    net.close()
+
+
+def _heights(peers):
+    return [p._channel.ledger.height for p in peers]
+
+
+def test_relay_frames_byte_identical_to_direct_pull(relay_world):
+    net, fabric, peers, services, taps = relay_world
+    assert _wait(lambda: sum(s.is_leader for s in services) == 1), \
+        [s.is_leader for s in services]
+    for i in range(12):
+        net.invoke([b"put", b"rk%d" % i, b"rv%d" % i])
+    # anchor the wait to the ORDERER tip: waiting for merely-equal
+    # peer heights races the fingerprint check against in-flight blocks
+    net.pump_committed(12)
+    target = net.support.store.height
+    assert target >= 3, target
+    assert _wait(lambda: all(h >= target for h in _heights(peers))), \
+        (_heights(peers), target)
+    # exactly ONE deliver client: the orderer served one stream for
+    # five peers (the whole point of the forest)
+    assert sum(s._client is not None for s in services) == 1
+    # all peers agree on state
+    fps = {p._channel.ledger.state_fingerprint() for p in peers}
+    assert len(fps) == 1, fps
+    # the relay actually carried frames, and every relayed frame is
+    # BYTE-IDENTICAL to what a direct orderer pull would have sent
+    idx = next(i for i, s in enumerate(services) if s.is_leader)
+    ledger = peers[idx]._channel.ledger
+    relayed = 0
+    for i, tap in enumerate(taps):
+        if i == idx:
+            assert not tap               # the root receives nothing
+            continue
+        for num, frame in tap:
+            blk = ledger.get_block_by_number(num)
+            assert blk is not None
+            assert frame == encode_frame(net.channel_id, "full", blk)
+            relayed += 1
+    assert relayed > 0
+    # non-leaf stats line up: the root pushed, interiors forwarded
+    root_stats = services[idx].relay.stats
+    assert root_stats["pushed"] > 0
+    assert sum(s.relay.stats["received"]
+               for s in services if s is not services[idx]) > 0
+    qe = peers[0]._channel.ledger.new_query_executor()
+    assert qe.get_state("mycc", "rk7") == b"rv7"
+
+
+def test_gap_repair_survives_injected_push_drops(relay_world):
+    net, fabric, peers, services, taps = relay_world
+    assert _wait(lambda: sum(s.is_leader for s in services) == 1)
+    plan = (faults.FaultPlan()
+            .add("dissemination.push", mode="drop", p=0.25, seed=11))
+    with faults.active(plan):
+        for i in range(14):
+            net.invoke([b"put", b"gk%d" % i, b"gv%d" % i])
+        # convergence DESPITE dropped relay sends: the payload-buffer
+        # gap + the relay's repair prod + the anti-entropy backstop
+        net.pump_committed(14)
+        target = net.support.store.height
+        assert _wait(lambda: all(h >= target for h in _heights(peers)),
+                     t=40), (_heights(peers), target)
+    assert plan.fires("dissemination.push") > 0
+    dropped = sum(s.relay.stats["dropped"] for s in services)
+    assert dropped > 0                   # the seam actually shed sends
+    fps = {p._channel.ledger.state_fingerprint() for p in peers}
+    assert len(fps) == 1, fps
+    qe = peers[-1]._channel.ledger.new_query_executor()
+    assert qe.get_state("mycc", "gk9") == b"gv9"
+
+
+def test_leadership_flap_demotes_and_resumes_from_height(relay_world):
+    net, fabric, peers, services, taps = relay_world
+    assert _wait(lambda: sum(s.is_leader for s in services) == 1)
+    idx = next(i for i, s in enumerate(services) if s.is_leader)
+    for i in range(5):
+        net.invoke([b"put", b"fk%d" % i, b"fv%d" % i])
+    assert _wait(lambda: len(set(_heights(peers))) == 1
+                 and _heights(peers)[0] >= 2), _heights(peers)
+
+    # kill the leader mid-stream: its relay root tears down with it
+    services[idx].stop()
+    assert not services[idx].relay.relay._thread or \
+        not services[idx].relay.relay._thread.is_alive()
+    peers[idx].stop()
+    survivors = [(p, s) for i, (p, s) in
+                 enumerate(zip(peers, services)) if i != idx]
+    for p, _ in survivors:
+        p.discovery.expiry_s = 1.0
+
+    def converged():
+        for p, _ in survivors:
+            p.discovery.tick_send_alive()
+            p.discovery.tick_check_alive()
+        return sum(s.is_leader for _, s in survivors) == 1
+    assert _wait(converged, t=30), [s.is_leader for _, s in survivors]
+
+    new_idx = next(i for i, (_, s) in enumerate(survivors)
+                   if s.is_leader)
+    new_leader = survivors[new_idx][1]
+    # promotion rebuilt the root from the channel's CURRENT height —
+    # the returning root relays new commits, not bulk history
+    assert new_leader.relay._is_root
+    assert new_leader.relay._root_from <= \
+        survivors[new_idx][0]._channel.ledger.height
+    pushed_before = new_leader.relay.stats["pushed"]
+
+    for i in range(5, 10):
+        net.invoke([b"put", b"fk%d" % i, b"fv%d" % i])
+    net.pump_committed(10)                # 5 pre-flap + 5 post-flap
+    target = net.support.store.height
+    assert _wait(lambda: all(p._channel.ledger.height >= target
+                             for p, _ in survivors),
+                 t=40), ([p._channel.ledger.height
+                          for p, _ in survivors], target)
+    # the NEW root carried the post-flap stream
+    assert _wait(lambda:
+                 new_leader.relay.stats["pushed"] > pushed_before, t=10)
+    fps = {p._channel.ledger.state_fingerprint()
+           for p, _ in survivors}
+    assert len(fps) == 1, fps
+    qe = survivors[0][0]._channel.ledger.new_query_executor()
+    assert qe.get_state("mycc", "fk8") == b"fv8"
+
+
+def test_demoted_root_stops_pushing_promotion_resumes():
+    """The pure transition contract, no network: demotion clears the
+    queues and stops feeding; promotion restarts from height."""
+    tree = RelayTree(["r:7051", "a:7051"], leader="r:7051", degree=2)
+
+    class _Ledger:
+        height = 7
+
+    node = _fake_node("r:7051")
+    node._channel.ledger = _Ledger()
+    svc = RelayService.__new__(RelayService)
+    svc._node = node
+    svc._cid = "ch"
+    from fabric_mod_tpu.concurrency.locks import RegisteredLock
+    svc._lock = RegisteredLock("dissemination.service._lock")
+    svc._is_root = False
+    svc._root_from = 0
+    svc.relay = BlockRelay(node, lambda: tree, queue_cap=4)
+    svc.relay.push_frame(1, b"x")
+    svc.on_leadership(True)
+    assert svc._is_root and svc._root_from == 7
+    # the promotion cleared stale queued frames
+    with svc.relay._lock:
+        assert not any(svc.relay._queues.values())
+    svc.relay.push_frame(8, b"y")
+    svc.on_leadership(False)
+    assert not svc._is_root
+    with svc.relay._lock:
+        assert not any(svc.relay._queues.values())
