@@ -1905,19 +1905,31 @@ def measure_multichannel(n_slices: int, n_channels: int, n_peers: int,
     }
 
 
-def measure_soak(seed, n_events) -> dict:
+def measure_soak(seed, n_events, kinds=None) -> dict:
     """Sustained soak-under-churn (host-only): the full SoakHarness
     run — mixed x509+idemix traffic across channels while the seeded
     ChurnPlan joins peers, revokes ACLs, reshapes batches, changes the
-    consenter set, and kills leaders, with the background fault plan
+    consenter set, kills leaders, hard-crashes + rejoins peers on
+    their durable dirs, restarts orderers from their WALs, and
+    installs/heals network partitions, with the background fault plan
     permanently armed.  Every invariant (fingerprint convergence
     within the recovery window, admitted => committed exactly once,
     no thread leaks, throughput recovery) gates BEFORE any rate is
     reported; the JSON carries per-event-kind recovery times and the
-    replayable seed + schedule."""
+    replayable seed + schedule.  `kinds` (--soak-kinds, comma list)
+    restricts the plan's event catalog."""
     from fabric_mod_tpu.observability import tracing
     from fabric_mod_tpu.soak import SoakConfig, SoakHarness
-    cfg = SoakConfig(seed=seed, n_events=n_events)
+    kind_tuple = None
+    if kinds:
+        from fabric_mod_tpu.soak import EVENT_KINDS
+        kind_tuple = tuple(k.strip() for k in kinds.split(",")
+                           if k.strip())
+        bad = [k for k in kind_tuple if k not in EVENT_KINDS]
+        if bad:
+            raise SystemExit(f"--soak-kinds: unknown kind(s) {bad}; "
+                             f"catalog: {', '.join(EVENT_KINDS)}")
+    cfg = SoakConfig(seed=seed, n_events=n_events, kinds=kind_tuple)
     log(f"soak: seed {cfg.seed}, {cfg.n_events} events, "
         f"{cfg.n_channels} channels, {cfg.n_peers} peers")
     harness = SoakHarness(cfg)
@@ -2800,7 +2812,8 @@ def _worker_metric(args) -> int:
         # host-only (no device): the churn-soak integration run; the
         # invariants gate inside the harness — reaching here means
         # every convergence/exactly-once/leak/recovery check passed
-        rep = measure_soak(args.soak_seed, args.soak_events)
+        rep = measure_soak(args.soak_seed, args.soak_events,
+                           kinds=args.soak_kinds)
         out = {
             "metric": "soak_churn_sustained_mixed_tx_per_sec",
             "value": rep["mixed_tx_per_sec"],
@@ -3234,6 +3247,8 @@ def supervise(args, argv) -> int:
                 cpu_argv += ["--soak-seed", str(args.soak_seed)]
             if args.soak_events is not None:
                 cpu_argv += ["--soak-events", str(args.soak_events)]
+            if args.soak_kinds is not None:
+                cpu_argv += ["--soak-kinds", args.soak_kinds]
         if args.metric == "deliverfanout":
             cpu_argv += ["--subscribers", str(args.subscribers)]
         if args.metric == "statescale":
@@ -3340,6 +3355,10 @@ def main() -> int:
     ap.add_argument("--soak-events", type=int, default=None,
                     help="soak: churn events per run (default "
                          "FMT_SOAK_EVENTS or 6)")
+    ap.add_argument("--soak-kinds", default=None,
+                    help="soak: comma list restricting the churn-kind "
+                         "pool (e.g. peer_crash_rejoin,orderer_restart)"
+                         " — default is the full 9-kind catalog")
     ap.add_argument("--subscribers", type=int, default=10000,
                     help="deliverfanout: top of the subscriber-count "
                          "sweep (>=3 points up to this)")
@@ -3405,6 +3424,8 @@ def main() -> int:
                 argv += ["--soak-seed", str(args.soak_seed)]
             if args.soak_events is not None:
                 argv += ["--soak-events", str(args.soak_events)]
+            if args.soak_kinds is not None:
+                argv += ["--soak-kinds", args.soak_kinds]
         if metric == "deliverfanout":
             argv += ["--subscribers", str(args.subscribers)]
         if metric == "statescale":

@@ -63,6 +63,11 @@ class RelayService:
         self._lock = RegisteredLock("dissemination.service._lock")
         self._is_root = False
         self._root_from = 0
+        # the membership view the current epoch was minted for: any
+        # change (join, crash-expiry, partition heal) rotates the
+        # epoch so the next tree() re-deals interior positions — the
+        # plumbed-but-never-advanced epoch of the PR 18/19 seam
+        self._epoch_members: Optional[frozenset] = None
 
     # -- tree derivation ---------------------------------------------------
     def _elected_leader(self) -> str:
@@ -77,8 +82,35 @@ class RelayService:
     def tree(self) -> RelayTree:
         members = [self._node.endpoint] + \
             [mb.endpoint for mb in self._node.discovery.alive_members()]
+        self._note_membership(members)
         return RelayTree(members, self._leader_source(),
                          epoch=self._epoch, degree=self._degree)
+
+    def _note_membership(self, members) -> None:
+        """Advance the epoch when the alive set changes: a joiner, an
+        expired crash victim, or a healed partition re-forms the tree
+        instead of freezing the old interior under the same rotation."""
+        key = frozenset(members)
+        with self._lock:
+            if self._epoch_members is None:
+                self._epoch_members = key
+            elif key != self._epoch_members:
+                self._epoch_members = key
+                self._epoch += 1
+                log.info("%s: membership changed -> relay epoch %d",
+                         self._node.endpoint, self._epoch)
+
+    def bump_epoch(self) -> int:
+        """Explicit rotation (the world's heal hook): the next tree()
+        re-parents even with an unchanged member set."""
+        with self._lock:
+            self._epoch += 1
+            return self._epoch
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:

@@ -38,7 +38,9 @@ DECLARED_POINTS: Set[str] = {
     "orderer.broadcast.stage",
     "orderer.raft.replicate",
     "orderer.raft.submit",
+    "orderer.wal.crash",
     "orderer.wal.sync",
+    "peer.ledger.crash",
     "peer.mvcc.vector",
     "sharding.dispatch",
 }

@@ -21,6 +21,7 @@ import threading
 from fabric_mod_tpu.utils.racecheck import OrderedLock
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from fabric_mod_tpu import faults
 from fabric_mod_tpu.ledger.blkstorage import BlockStore
 from fabric_mod_tpu.ledger.mvcc import (
     COLUMNAR, validate_and_prepare_batch,
@@ -448,6 +449,12 @@ class KvLedger:
             with tracing.span("ledger_write", block=num):
                 with H_BLOCK_COMMIT.time():
                     self.blockstore.add_block(block)
+                # the crash seam of the recovery contract: an armed
+                # error-mode rule kills the commit AFTER the block is
+                # durable in the block store but BEFORE any statedb /
+                # history / pvt effect — exactly the statedb-behind-
+                # blockstore window _recover() must replay on reopen
+                faults.point("peer.ledger.crash")
                 with H_STATE_COMMIT.time():
                     self._apply_state_updates(batch, num)
                     # per-tx writes (not the deduped batch) so commit
@@ -808,6 +815,14 @@ class KvLedger:
 
     def tx_id_exists(self, txid: str) -> bool:
         return self.blockstore.get_tx_loc(txid) is not None
+
+    def snapshot_to(self, out_dir: str) -> dict:
+        """Consistent snapshot export: ledger/snapshot.generate_snapshot
+        under the commit lock, so no block lands mid-iteration of the
+        state it seals."""
+        from fabric_mod_tpu.ledger.snapshot import generate_snapshot
+        with self._lock:
+            return generate_snapshot(self, out_dir)
 
     def close(self) -> None:
         with self._lock:

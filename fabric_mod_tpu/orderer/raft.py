@@ -287,6 +287,11 @@ class RaftWAL:
         payload = (bytes([_ENTRY]) + struct.pack("<qq", term, index)
                    + data)
         self._f.write(self._frame(payload))
+        # crash seam: an error-mode rule kills the orderer AFTER the
+        # frame hit the (possibly still unsynced) file but BEFORE any
+        # ack could be built on it — the torn-tail window a restarted
+        # node's _replay() crops, then AppendEntries repair refills
+        faults.point("orderer.wal.crash")
         self._dirty = True
         if not self._group:
             self.sync()

@@ -30,6 +30,10 @@ Knobs (all env-overridable, the FMT_SOAK_* table in README):
                           relay root (recovery recorded under
                           kind=relay_reparent), and the run fails if
                           the relay never carried a block
+  FMT_SOAK_NO_CRASH       1 = drop the crash-shaped kinds from the
+                          default plan (in the pool since PR 20)
+  FMT_SOAK_PARTITION_S    network_partition hold time   (default 2.0)
+  FMT_SOAK_CRASH_HOLD_S   crash/restart down window     (default 1.0)
 """
 from __future__ import annotations
 
@@ -44,12 +48,16 @@ from fabric_mod_tpu.observability import get_logger
 from fabric_mod_tpu.protos import messages as m
 from fabric_mod_tpu.protos import protoutil
 from fabric_mod_tpu.soak.invariants import InvariantChecker, SoakError
-from fabric_mod_tpu.soak.plan import ChurnPlan
+from fabric_mod_tpu.soak.plan import CORE_KINDS, ChurnPlan
 from fabric_mod_tpu.soak.workload import MixedWorkload
 from fabric_mod_tpu.soak.world import SoakWorld
 from fabric_mod_tpu.utils import knobs
 
 log = get_logger("soak.harness")
+
+# the crash-shaped PR 20 kinds FMT_SOAK_NO_CRASH=1 drops from the plan
+CRASH_KINDS = ("peer_crash_rejoin", "orderer_restart",
+               "network_partition")
 
 
 class SoakConfig:
@@ -62,7 +70,10 @@ class SoakConfig:
                  min_recovery_frac: Optional[float] = None,
                  x509_gap_s: Optional[float] = None,
                  idemix_gap_s: Optional[float] = None,
-                 fault_p: Optional[float] = None):
+                 fault_p: Optional[float] = None,
+                 kinds: Optional[Tuple[str, ...]] = None,
+                 partition_s: Optional[float] = None,
+                 crash_hold_s: Optional[float] = None):
         gap_env = knobs.get_str("FMT_SOAK_GAP_TXS", "")
         if gap_txs is None and gap_env:
             try:
@@ -91,6 +102,20 @@ class SoakConfig:
             else knobs.get_float("FMT_SOAK_IDEMIX_GAP_S")
         self.fault_p = fault_p if fault_p is not None else \
             knobs.get_float("FMT_SOAK_FAULT_P")
+        # event-kind selection: an explicit list (bench --soak-kinds)
+        # wins; else the full 9-kind core, minus the crash-shaped
+        # kinds when FMT_SOAK_NO_CRASH=1
+        if kinds is not None:
+            self.kinds: Optional[Tuple[str, ...]] = tuple(kinds)
+        elif knobs.get_bool("FMT_SOAK_NO_CRASH"):
+            self.kinds = tuple(k for k in CORE_KINDS
+                               if k not in CRASH_KINDS)
+        else:
+            self.kinds = None              # plan default (CORE_KINDS)
+        self.partition_s = partition_s if partition_s is not None \
+            else knobs.get_float("FMT_SOAK_PARTITION_S")
+        self.crash_hold_s = crash_hold_s if crash_hold_s is not None \
+            else knobs.get_float("FMT_SOAK_CRASH_HOLD_S")
 
 
 def background_fault_plan(seed: int, p: float) -> faults.FaultPlan:
@@ -128,8 +153,13 @@ class SoakHarness:
         self.cfg = config or SoakConfig()
         self._root = root
         self.plan = ChurnPlan(self.cfg.seed, self.cfg.n_events,
-                              gap_txs=self.cfg.gap_txs)
+                              gap_txs=self.cfg.gap_txs,
+                              kinds=self.cfg.kinds)
         self._rng = random.Random(self.cfg.seed ^ 0xC0FFEE)
+        # satellite contract: exactly one join per run takes the
+        # snapshot fast lane; the rest replay from genesis, and the
+        # convergence gate proves both lanes land on one fingerprint
+        self._snap_join_done = False
 
     # -- event execution ---------------------------------------------------
 
@@ -147,7 +177,29 @@ class SoakHarness:
         post-convergence assertions use."""
         ctx: Dict = {"kind": kind}
         if kind == "peer_join":
-            ctx["peer"] = world.add_peer().name
+            snap = not self._snap_join_done
+            self._snap_join_done = True
+            ctx["peer"] = world.add_peer(snapshot=snap).name
+            ctx["snapshot_join"] = snap
+        elif kind == "peer_crash_rejoin":
+            victim = world.crash_peer()
+            ctx["peer"] = victim.name
+            # the down window: traffic keeps flowing (the lanes run in
+            # their own threads) so the rejoin has a real tail for
+            # _recover + gossip to catch up
+            time.sleep(self.cfg.crash_hold_s)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
+            world.rejoin_peer(victim)
+        elif kind == "orderer_restart":
+            ctx["orderer"] = world.restart_orderer(
+                hold_s=self.cfg.crash_hold_s)
+        elif kind == "network_partition":
+            peer_names, ord_ids = world.install_partition()
+            ctx["peers"] = peer_names
+            ctx["orderers"] = ord_ids
+            # scheduled heal: hold the cut under live traffic, then
+            # let the fingerprint-convergence window gate the merge
+            time.sleep(self.cfg.partition_s)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
+            world.heal_partition(peer_names, ord_ids)
         elif kind == "acl_revoke":
             ctx["pre_h"] = world.revoke_audit_org()
         elif kind == "batch_config":
@@ -231,6 +283,67 @@ class SoakHarness:
                     f"after killing {ctx['orderer']} "
                     f"(leader_of={new_leader!r})", self.plan)
             ctx["new_leader"] = new_leader
+        elif ctx["kind"] == "peer_crash_rejoin":
+            # convergence already proved one fingerprint across every
+            # peer INCLUDING the rejoin; make the replay explicit: the
+            # rejoined ledger must match p0 on every channel.  The
+            # post-event traffic phase ran between that gate and this
+            # check, so quiesce and compare at identical heights —
+            # an instantaneous read races in-flight commits and fakes
+            # a divergence out of ordinary catch-up lag.
+            peer = next(p for p in world.peers
+                        if p.name == ctx["peer"])
+            checker.workload.pause()
+            try:
+                deadline = time.monotonic() + checker.window_s
+                while True:
+                    lag = None
+                    for cid in world.channel_ids:
+                        if peer.height(cid) != \
+                                world.peers[0].height(cid) or \
+                                peer.fingerprint(cid) != \
+                                world.peers[0].fingerprint(cid):
+                            lag = cid
+                            break
+                    if lag is None:
+                        break
+                    if time.monotonic() >= deadline:
+                        raise SoakError(
+                            f"peer_crash_rejoin: {peer.name} diverged "
+                            f"on {lag} after recovery replay "
+                            f"(height {peer.height(lag)} vs p0 "
+                            f"{world.peers[0].height(lag)})",
+                            self.plan)
+                    time.sleep(0.05)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
+            finally:
+                checker.workload.resume()
+            ctx["heights"] = {cid: peer.height(cid)
+                              for cid in world.channel_ids}
+        elif ctx["kind"] == "orderer_restart":
+            oid = ctx["orderer"]
+            o = next((x for x in world.live_orderers()
+                      if x.oid == oid), None)
+            if o is None:
+                raise SoakError(
+                    f"orderer_restart: {oid} not live after its "
+                    "restart", self.plan)
+            for cid in world.channel_ids:
+                sup = o.registrar.get_chain(cid)
+                if sup is None:
+                    raise SoakError(
+                        f"orderer_restart: {oid} lost channel {cid} "
+                        "across the restart", self.plan)
+            ctx["store_heights"] = {
+                cid: o.registrar.get_chain(cid).store.height
+                for cid in world.channel_ids}
+        elif ctx["kind"] == "network_partition":
+            for cid in world.channel_ids:
+                if world.networks[cid].partitioned or \
+                        world.transports[cid].partitioned:
+                    raise SoakError(
+                        f"network_partition: seam on {cid} still "
+                        "holds a cut after the scheduled heal",
+                        self.plan)
 
     def _run_traffic(self, workload: MixedWorkload, gap_txs: int,
                      label: str) -> float:

@@ -25,9 +25,27 @@ system scale):
   leader_kill      the raft leader is halted mid-traffic; the
                    survivors re-elect and ordering continues
 
+Crash-shaped kinds (PR 20 — each is down-then-up WITHIN one event, so
+the member/live bookkeeping is unchanged after it completes):
+
+  peer_crash_rejoin  a peer is hard-crashed (no flush, no clean close)
+                     and a fresh peer reopens the SAME durable ledger
+                     dirs — KvLedger._recover replays statedb-behind-
+                     blockstore and gossip/relay reconverges the tail
+  orderer_restart    a live orderer is halted mid-traffic and a fresh
+                     Registrar boots from its existing WAL dir — torn
+                     tails cropped, HardState honored, catch-up via
+                     AppendEntries repair; quorum must hold while it
+                     is down (leader_kill's precondition)
+  network_partition  a symmetric partition (peer group + minority
+                     orderer group) is installed, traffic flows, then
+                     the partition heals on schedule — convergence is
+                     gated by the same fingerprint window
+
 The planner tracks (members, live_members) so a generated schedule can
 never break raft quorum: leader_kill / consenter_remove are only
-scheduled while a majority of the post-event member set stays live.
+scheduled while a majority of the post-event member set stays live,
+and orderer_restart only while the restart window can be survived.
 """
 from __future__ import annotations
 
@@ -36,14 +54,19 @@ import random
 from typing import List, Optional, Sequence, Tuple
 
 EVENT_KINDS = ("peer_join", "acl_revoke", "batch_config",
-               "consenter_add", "consenter_remove", "leader_kill")
+               "consenter_add", "consenter_remove", "leader_kill",
+               "peer_crash_rejoin", "orderer_restart",
+               "network_partition")
 
-# the five-kind core the acceptance gate requires every default run to
-# execute (consenter_add and consenter_remove are one "membership
-# change" family; both are in the default core so joins and repairs
-# are each exercised)
+# the kinds the acceptance gate requires every default run to execute
+# (consenter_add and consenter_remove are one "membership change"
+# family; both are in the default core so joins and repairs are each
+# exercised; the three crash-shaped kinds are core since PR 20 so the
+# recovery paths they exercise run on every default soak)
 CORE_KINDS = ("peer_join", "acl_revoke", "batch_config",
-              "consenter_add", "leader_kill", "consenter_remove")
+              "consenter_add", "leader_kill", "consenter_remove",
+              "peer_crash_rejoin", "orderer_restart",
+              "network_partition")
 
 
 class ChurnEvent:
@@ -85,9 +108,13 @@ class _PlanState:
         self.peer_joins_left = max_peer_joins
 
     def allowed(self, kind: str) -> bool:
-        if kind == "leader_kill":
-            # after the kill a majority of the UNCHANGED member set
-            # must remain live or ordering halts for good
+        if kind in ("leader_kill", "orderer_restart",
+                    "network_partition"):
+            # after the kill (or during the restart's down window /
+            # the partition's hold) a majority of the UNCHANGED
+            # member set must remain live-and-connected or ordering
+            # halts for good (the partition cuts one voting orderer
+            # to the minority side)
             return self.live_members - 1 >= _majority(self.members)
         if kind == "consenter_remove":
             if self.members <= 2:
@@ -100,7 +127,9 @@ class _PlanState:
             return not self.audit_revoked
         if kind == "peer_join":
             return self.peer_joins_left > 0
-        return True                        # batch_config, consenter_add
+        # batch_config, consenter_add, peer_crash_rejoin (down-then-up
+        # on the ledger side only — never an ordering-quorum concern)
+        return True
 
     def apply(self, kind: str) -> None:
         if kind == "leader_kill":
@@ -120,6 +149,9 @@ class _PlanState:
             self.audit_revoked = True
         elif kind == "peer_join":
             self.peer_joins_left -= 1
+        # peer_crash_rejoin / orderer_restart / network_partition end
+        # with the pre-event member and liveness sets restored — the
+        # down window's safety is the allowed() precondition
 
 
 class ChurnPlan:

@@ -115,7 +115,7 @@ class _FailoverSource:
 
 class _Orderer:
     __slots__ = ("oid", "registrar", "broadcast", "signer", "dead",
-                 "removed")
+                 "removed", "partitioned")
 
     def __init__(self, oid, registrar, broadcast, signer):
         self.oid = oid
@@ -124,6 +124,9 @@ class _Orderer:
         self.signer = signer
         self.dead = False
         self.removed = set()               # channels configured out
+        # behind a network partition: raft messages black-holed and
+        # clients route around it until the heal clears the flag
+        self.partitioned = False
 
 
 class SoakPeer:
@@ -134,6 +137,7 @@ class SoakPeer:
         self.name = name
         self.org = org
         self.world = world
+        self.crashed = False
         cert, key = world.cas[org].issue(
             f"{name}.{org.lower()}", org, ous=["peer"])
         self.signer = SigningIdentity(org, cert, calib.key_pem(key),
@@ -194,6 +198,8 @@ class SoakPeer:
             svc.start()
 
     def stop(self) -> None:
+        if getattr(self, "crashed", False):
+            return                         # already hard-dropped
         for svc in self.services.values():
             svc.stop()
         for node in self.nodes.values():
@@ -204,6 +210,26 @@ class SoakPeer:
             # the ledgers they write go away
             self.router.close()
         self.ledger_mgr.close()
+
+    def crash(self) -> None:
+        """Hard-drop: every registered thread is torn down (the leak
+        sweep must stay clean — a crashed process has no threads) but
+        the durable ledgers are ABANDONED, not closed: no checkpoint,
+        no flush.  Whatever the per-block fsyncs already made durable
+        survives on disk; buffered frames and in-flight commits are
+        lost by design, and `KvLedger._recover` on the rejoined peer's
+        reopen is what repairs the statedb-behind-blockstore window.
+        The world retains a strong reference to this object (see
+        `SoakWorld.crashed_peers`) so the abandoned append-mode
+        handles are never GC-finalized — a finalizer flush would write
+        stale buffered bytes under the rejoined peer's feet."""
+        for svc in self.services.values():
+            svc.stop()
+        for node in self.nodes.values():
+            node.stop()
+        if self.router is not None:
+            self.router.close()
+        self.crashed = True
 
 
 class _Subscriber:
@@ -315,10 +341,22 @@ class SoakWorld:
 
         self.orderers: Dict[str, _Orderer] = {}
         self._bootstrap_ids = list(orderer_ids)
+        # registrars replaced by restart_orderer: their stores' idle
+        # handles are closed at world teardown, never mid-run
+        self._retired_registrars: List[Registrar] = []
         for oid in orderer_ids:
             self._boot_orderer(oid)
 
         self.peers: List[SoakPeer] = []
+        # hard-crashed SoakPeers, retained forever: dropping the last
+        # reference would let GC finalize their abandoned append-mode
+        # durable handles — a buffered-byte flush into files the
+        # rejoined peer now owns
+        self.crashed_peers: List[SoakPeer] = []
+        # monotonically-issued peer names: a crash removes its victim
+        # from self.peers, so len(self.peers) can no longer name
+        # joiners without colliding with a crashed peer's dirs
+        self._peer_seq = n_peers
         for i in range(n_peers):
             self.peers.append(SoakPeer(
                 self, f"p{i}", self.orgs[i % len(self.orgs)]))
@@ -362,7 +400,11 @@ class SoakWorld:
 
         reg = Registrar(root, signer, self.csp, chain_factory=factory)
         for cid in self.channel_ids:
-            reg.create_channel(self.genesis[cid])
+            # a RESTART boots over existing dirs: the Registrar ctor
+            # already recovered those channels (WAL replay + store
+            # tip); only genuinely new dirs get the genesis block
+            if reg.get_chain(cid) is None:
+                reg.create_channel(self.genesis[cid])
         o = _Orderer(oid, reg, Broadcast(reg), signer)
         with self._lock:
             self.orderers[oid] = o
@@ -370,7 +412,8 @@ class SoakWorld:
 
     def live_orderers(self) -> List[_Orderer]:
         with self._lock:
-            return [o for o in self.orderers.values() if not o.dead]
+            return [o for o in self.orderers.values()
+                    if not o.dead and not o.partitioned]
 
     def chains(self, cid: str) -> Dict[str, object]:
         """Live, still-configured-in chains for a channel."""
@@ -417,10 +460,12 @@ class SoakWorld:
         through live orderers (the NOT_LEADER retry path)."""
         lead = self.leader_of(cid)
         with self._lock:
-            if lead is not None and not self.orderers[lead].dead:
+            if lead is not None and not self.orderers[lead].dead \
+                    and not self.orderers[lead].partitioned:
                 return self.orderers[lead].broadcast
             live = [o for o in self.orderers.values()
-                    if not o.dead and cid not in o.removed]
+                    if not o.dead and not o.partitioned
+                    and cid not in o.removed]
             self._rr += 1
             return live[self._rr % len(live)].broadcast
 
@@ -437,6 +482,39 @@ class SoakWorld:
                     sup.chain.halt()
                 except Exception:  # fmtlint: allow[swallowed-exceptions] -- leader-kill chaos event: halting an already-dying chain is best-effort
                     pass
+
+    def restart_orderer(self, oid: Optional[str] = None,
+                        hold_s: float = 0.0) -> str:
+        """Crash-restart an orderer: halt its chains mid-traffic (the
+        kill_orderer SIGKILL analog), retire the old Registrar object,
+        and boot a FRESH one over the same ord/<oid> dirs — the WAL
+        replay crops any torn tail, the HardState keeps term/vote,
+        `_tip_raft_index` skips blocks already in the store, and
+        AppendEntries repair refills whatever the halt lost.  Nothing
+        the old incarnation ever ACKED may go missing: every ack sat
+        behind a WAL sync barrier, so the replayed log carries it into
+        the final exactly-once audit.  Prefers a live, fully-voting
+        non-leader (quorum holds while it is down — the planner's
+        precondition)."""
+        if oid is None:
+            lead = self.leader_of(self.channel_ids[0])
+            with self._lock:
+                cands = sorted(o.oid for o in self.orderers.values()
+                               if not o.dead and not o.partitioned
+                               and not o.removed)
+            if not cands:
+                raise RuntimeError("no live orderer to restart")
+            oid = next((x for x in cands if x != lead), cands[0])
+        self.kill_orderer(oid)
+        with self._lock:
+            self._retired_registrars.append(self.orderers[oid].registrar)
+        if hold_s > 0:
+            # the down window: traffic keeps flowing through the
+            # surviving quorum while this member is gone
+            time.sleep(hold_s)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
+        log.info("soak: restarting orderer %s from its WAL dir", oid)
+        self._boot_orderer(oid)
+        return oid
 
     # -- config events -----------------------------------------------------
 
@@ -583,14 +661,30 @@ class SoakWorld:
 
     # -- peers -------------------------------------------------------------
 
-    def add_peer(self) -> SoakPeer:
+    def add_peer(self, snapshot: bool = False) -> SoakPeer:
         """A peer joining mid-run: fresh ledgers from genesis, gossip
         join, catch-up via anti-entropy state transfer (the
         GossipStateProvider.anti_entropy_tick -> node._pull_range path
-        at scale)."""
-        org = self.orgs[len(self.peers) % len(self.orgs)]
-        peer = SoakPeer(self, f"p{len(self.peers)}", org)
+        at scale).  With `snapshot=True` the join takes the PR 20 fast
+        lane instead: the newcomer's ledger dirs are seeded from a
+        snapshot of p0's state BEFORE the SoakPeer opens them, so it
+        starts at the snapshot height and only gossips the tail —
+        the convergence gate then proves its fingerprint matches the
+        genesis-replay joiners' bit for bit."""
+        org = self.orgs[self._peer_seq % len(self.orgs)]
+        name = f"p{self._peer_seq}"
+        self._peer_seq += 1
+        if snapshot:
+            self._seed_peer_from_snapshot(name)
+        peer = SoakPeer(self, name, org)
         self.peers.append(peer)
+        self._join_gossip(peer)
+        peer.start()
+        log.info("soak: peer %s joined (org %s, snapshot=%s)",
+                 peer.name, org, snapshot)
+        return peer
+
+    def _join_gossip(self, peer: SoakPeer) -> None:
         for cid in self.channel_ids:
             eps = [p.nodes[cid].endpoint for p in self.peers]
             peer.nodes[cid].join(eps)
@@ -599,9 +693,188 @@ class SoakWorld:
             for _ in range(2):
                 for p in self.peers:
                     p.nodes[cid].discovery.tick_send_alive()
+
+    def _seed_peer_from_snapshot(self, name: str) -> Dict[str, int]:
+        """Export p0's state per channel (consistent: under the commit
+        lock) and bootstrap the newcomer's ledger dirs at the snapshot
+        height.  Must run BEFORE SoakPeer construction — the bootstrap
+        refuses dirs that already hold a ledger."""
+        from fabric_mod_tpu.ledger.snapshot import bootstrap_from_snapshot
+        heights: Dict[str, int] = {}
+        for cid in self.channel_ids:
+            src = self.peers[0].channels[cid].ledger
+            snap = os.path.join(self.root, "snapshots", name, cid)
+            meta = src.snapshot_to(snap)
+            led = bootstrap_from_snapshot(
+                snap, os.path.join(self.root, "peers", name, cid))
+            heights[cid] = led.height
+            led.close()                    # reopened by the SoakPeer
+            log.info("soak: %s/%s snapshot-bootstrapped at height %d",
+                     name, cid, meta["height"])
+        return heights
+
+    # -- crash/rejoin + partitions (PR 20) ---------------------------------
+
+    def crash_peer(self, name: Optional[str] = None) -> SoakPeer:
+        """Hard-crash a non-anchor peer (p0 anchors the endorsers, the
+        event server, and the audit subscription — never crashed).
+        The victim leaves `self.peers`, its threads die, its durable
+        dirs stay on disk, and the object itself is retained in
+        `crashed_peers` (see SoakPeer.crash for why).  Survivors then
+        expire its endpoints so membership — and any relay tree built
+        over it — genuinely re-forms."""
+        with self._lock:
+            candidates = self.peers[1:]
+            if not candidates:
+                raise RuntimeError("no crashable peer (p0 is anchored)")
+            victim = (next(p for p in candidates if p.name == name)
+                      if name is not None else candidates[-1])
+            self.peers.remove(victim)
+            self.crashed_peers.append(victim)
+        log.info("soak: hard-crashing peer %s", victim.name)
+        victim.crash()
+        self._drive_expiry(
+            {cid: {victim.nodes[cid].endpoint}
+             for cid in self.channel_ids})
+        return victim
+
+    def rejoin_peer(self, crashed: SoakPeer) -> SoakPeer:
+        """Rejoin after a crash: a FRESH SoakPeer over the SAME
+        durable dirs.  `KvLedger._recover` replays any
+        statedb-behind-blockstore window (rebuilding the incremental
+        XOR fingerprint through the same `_apply_state_updates`
+        funnel) and gossip/relay converge the tail — the same join
+        choreography as add_peer, minus the genesis bootstrap its
+        nonzero heights skip."""
+        peer = SoakPeer(self, crashed.name, crashed.org)
+        self.peers.append(peer)
+        self._join_gossip(peer)
         peer.start()
-        log.info("soak: peer %s joined (org %s)", peer.name, org)
+        log.info("soak: peer %s rejoined its ledger dirs (heights %s)",
+                 peer.name,
+                 {cid: peer.height(cid) for cid in self.channel_ids})
         return peer
+
+    def install_partition(self):
+        """The symmetric partition: the highest-numbered non-anchor
+        peer plus one fully-voting non-leader orderer drop off every
+        channel's gossip network AND raft transport.  Each side
+        expires the other (the victim peer elects itself and converges
+        alone; survivors re-form their trees); clients route around
+        the partitioned orderer, whose raft messages black-hole until
+        the heal.  Returns (peer_names, orderer_ids) for
+        heal_partition."""
+        with self._lock:
+            peer_victims = ([self.peers[-1]]
+                            if len(self.peers) > 1 else [])
+        lead = self.leader_of(self.channel_ids[0])
+        with self._lock:
+            ord_cands = sorted(o.oid for o in self.orderers.values()
+                               if not o.dead and not o.partitioned
+                               and not o.removed and o.oid != lead)
+            # quorum guard (the planner's precondition, re-checked at
+            # runtime): cutting a voting orderer to the minority side
+            # must leave a majority of the voting set connected, else
+            # ordering halts for the whole hold
+            voting = [o for o in self.orderers.values()
+                      if not o.removed]
+            connected = sum(1 for o in voting
+                            if not o.dead and not o.partitioned)
+            ord_victims = (ord_cands[:1]
+                           if connected - 1 >= len(voting) // 2 + 1
+                           else [])
+            for oid in ord_victims:
+                self.orderers[oid].partitioned = True
+        for cid in self.channel_ids:
+            for p in peer_victims:
+                self.networks[cid].partitioned.add(
+                    p.nodes[cid].endpoint)
+            for oid in ord_victims:
+                # raft traffic AND forwarded submits address the two
+                # registered transport identities
+                self.transports[cid].partitioned.add(oid)
+                self.transports[cid].partitioned.add(f"{oid}:chain")
+        log.info("soak: partition installed (peers=%s orderers=%s)",
+                 [p.name for p in peer_victims], ord_victims)
+        if peer_victims:
+            self._drive_expiry(
+                {cid: {p.nodes[cid].endpoint for p in peer_victims}
+                 for cid in self.channel_ids})
+        return [p.name for p in peer_victims], ord_victims
+
+    def heal_partition(self, peer_names: List[str],
+                       orderer_ids: List[str]) -> None:
+        """Remove the cut: membership re-merges over a few alive
+        rounds, the deliver election re-converges, the partitioned
+        orderer's raft log is repaired by AppendEntries, and every
+        relay tree re-deals via an explicit epoch bump."""
+        for cid in self.channel_ids:
+            for name in peer_names:
+                p = next(q for q in self.peers if q.name == name)
+                self.networks[cid].partitioned.discard(
+                    p.nodes[cid].endpoint)
+            for oid in orderer_ids:
+                self.transports[cid].partitioned.discard(oid)
+                self.transports[cid].partitioned.discard(f"{oid}:chain")
+        with self._lock:
+            for oid in orderer_ids:
+                self.orderers[oid].partitioned = False
+        for _ in range(3):
+            for cid in self.channel_ids:
+                for p in self.peers:
+                    p.nodes[cid].discovery.tick_send_alive()
+            time.sleep(0.05)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
+        self.bump_relay_epochs()
+        log.info("soak: partition healed (peers=%s orderers=%s)",
+                 peer_names, orderer_ids)
+
+    def bump_relay_epochs(self) -> None:
+        """Explicit tree rotation after a membership-shaped event
+        (FMT_SOAK_RELAY mode): every peer's next tree() re-parents
+        even where its alive set ends up identical to the pre-event
+        view."""
+        for p in self.peers:
+            for svc in p.services.values():
+                relay = getattr(svc, "relay", None)
+                if relay is not None:
+                    relay.bump_epoch()
+
+    def _drive_expiry(self, targets: Dict[str, set],
+                      timeout_s: float = 20.0) -> None:
+        """Drive manual alive/expiry rounds (discovery is never
+        background-ticked in the soak) under a temporarily tightened
+        expiry until every endpoint in targets[cid] has dropped out of
+        every OTHER live peer's membership view on cid.  Sends across
+        a partition seam are dropped by the seam itself, so both sides
+        of a cut expire each other in the same rounds."""
+        deadline = time.monotonic() + timeout_s
+        saved = {}
+        for cid in targets:
+            for p in self.peers:
+                saved[(p.name, cid)] = p.nodes[cid].discovery.expiry_s
+                p.nodes[cid].discovery.expiry_s = 0.6
+        try:
+            while time.monotonic() < deadline:
+                gone = True
+                for cid, eps in targets.items():
+                    for p in self.peers:
+                        d = p.nodes[cid].discovery
+                        d.tick_send_alive()
+                        d.tick_check_alive()
+                        if p.nodes[cid].endpoint not in eps and \
+                                eps & set(d.alive_endpoints()):
+                            gone = False
+                if gone:
+                    return
+                time.sleep(0.15)  # fmtlint: allow[clocks] -- real OS-thread pacing: the soak's ManualClock accelerates raft only; harness waits are wall-time by design
+        finally:
+            for (pname, cid), v in saved.items():
+                p = next((q for q in self.peers if q.name == pname),
+                         None)
+                if p is not None:
+                    p.nodes[cid].discovery.expiry_s = v
+        raise RuntimeError(
+            f"endpoints never expired from live membership: {targets}")
 
     # -- dissemination relay (FMT_SOAK_RELAY) ------------------------------
 
@@ -733,9 +1006,14 @@ class SoakWorld:
         if self._pump is not None:
             assert_joined((self._pump,), owner="SoakWorld", timeout=5)
         with self._lock:
-            orderers = list(self.orderers.values())
-        for o in orderers:
+            regs = ([o.registrar for o in self.orderers.values()]
+                    + list(self._retired_registrars))
+        # crashed peers are deliberately NOT closed: their ledgers were
+        # abandoned mid-flight and stay abandoned (the refs in
+        # self.crashed_peers outlive the world so no finalizer flush
+        # ever runs against a rejoined peer's files)
+        for reg in regs:
             try:
-                o.registrar.close()
+                reg.close()
             except Exception:  # fmtlint: allow[swallowed-exceptions] -- world teardown after chaos: a dead orderer's close must not mask the run's result
                 pass

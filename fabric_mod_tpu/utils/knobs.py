@@ -173,6 +173,17 @@ declare("FMT_SOAK_RELAY", "bool", None,
         "exercises reparenting + anti-entropy repair, and leader_kill "
         "additionally flaps the relay root (recovery recorded as "
         "kind=relay_reparent)")
+declare("FMT_SOAK_NO_CRASH", "bool", None,
+        "1 drops the crash-shaped churn kinds (peer_crash_rejoin, "
+        "orderer_restart, network_partition) from the default plan "
+        "(they are in the pool by default since PR 20)")
+declare("FMT_SOAK_PARTITION_S", "float", 2.0,
+        "network_partition hold time (s): traffic keeps flowing on "
+        "the majority side before the scheduled heal")
+declare("FMT_SOAK_CRASH_HOLD_S", "float", 1.0,
+        "peer_crash_rejoin / orderer_restart down window (s): traffic "
+        "continues while the victim is gone, so its rejoin has a real "
+        "tail to recover")
 
 # -- device / kernel routing ------------------------------------------------
 declare("FABRIC_MOD_TPU_MIXED_ADD", "bool", None,

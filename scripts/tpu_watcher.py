@@ -122,11 +122,17 @@ MATRIX = [
     ("broadcaststorm_staged_16client",
      ["--metric", "broadcaststorm", "--batch", "256", "--clients", "16",
       "--staged-batch", "64", "--storm-verifier", "device"], {}, 1500),
-    # host-only churn soak: a longer on-hardware schedule (12 events)
-    # with the fixed seed — every convergence/exactly-once/leak
-    # invariant gates before the sustained mixed tx/s is recorded
+    # host-only churn soak over the FULL 9-kind plan (the crash-shaped
+    # PR 20 kinds — peer_crash_rejoin, orderer_restart,
+    # network_partition — included): a longer on-hardware schedule
+    # (12 events, so the core catalog fires once plus repeats) with
+    # the fixed seed — every convergence/exactly-once/leak invariant
+    # plus the crash-replay and WAL-restart gates pass before the
+    # sustained mixed tx/s is recorded, and the capture carries the
+    # per-kind fabric_soak_recovery_seconds breakdown
+    # (recovery_s_by_kind) for all nine kinds
     ("soak", ["--metric", "soak", "--soak-seed", "8",
-              "--soak-events", "12"], {}, 1200),
+              "--soak-events", "12"], {}, 1500),
     # host-only shared deliver fan-out at full scale: 10k mixed
     # full/filtered subscribers over sustained commit traffic; every
     # swept point gates byte-identity (shared frames == the per-stream

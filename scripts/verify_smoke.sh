@@ -161,6 +161,18 @@ FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
 FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
     -p no:cacheprovider -p no:randomly -m 'not slow' \
     tests/test_dissemination.py
+# 0l. the crash-recovery slice, FMT_RACECHECK=1: the deterministic
+#     crash seams behind the soak's PR 20 churn kinds — the
+#     peer.ledger.crash fault between blockstore append and state
+#     apply (reopen replays statedb-behind-blockstore, incremental
+#     fingerprint == full-rescan oracle, crashed peer == uncrashed
+#     differential), the orderer.wal.crash fault (synced prefix
+#     survives bit-exact, the never-acked in-buffer tail never
+#     surfaces), and the physically-torn WAL tail (CRC crop +
+#     truncate, post-restart appends land on a clean end)
+FMT_RACECHECK=1 JAX_PLATFORMS=cpu python -m pytest -q \
+    -p no:cacheprovider -p no:randomly -m 'not slow' \
+    tests/test_crash_recovery.py
 # vectorized-armed commitpipe differential: the whole pipelined/sync/
 # depth1/traced gate set re-run with FABRIC_MOD_TPU_VECTOR_MVCC hot,
 # so the columnar MVCC path is proven inside the real commit pipeline

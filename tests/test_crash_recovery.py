@@ -1,0 +1,142 @@
+"""Crash–restart recovery seams, deterministically (PR 20).
+
+The soak's crash-shaped churn kinds prove these paths end-to-end under
+load; this file pins each seam in isolation with the crash INJECTED at
+the exact window the recovery contract names:
+
+  * `peer.ledger.crash` — KvLedger dies AFTER the block store append,
+    BEFORE any statedb/history effect: the statedb-behind-blockstore
+    window `_recover()` must replay on reopen, incremental XOR
+    fingerprint included (kv_ledger.go recoverDBs is the reference);
+  * `orderer.wal.crash` — RaftWAL dies AFTER the frame write, BEFORE
+    the durability barrier: the torn/unsynced tail was never acked,
+    CRC replay crops it, the synced prefix survives byte-for-byte.
+"""
+import struct
+
+import pytest
+
+from fabric_mod_tpu import faults
+from fabric_mod_tpu.ledger.kvledger import KvLedger
+from fabric_mod_tpu.orderer.raft import RaftWAL
+from fabric_mod_tpu.protos import protoutil
+from tests.test_ledger import _block, _endorser_env, _rw
+
+
+def _mkblocks(n):
+    """One shared chain of single-tx blocks: both the crashing and the
+    clean ledger commit IDENTICAL bytes, so their fingerprints are
+    comparable."""
+    blocks, prev = [], b""
+    for i in range(n):
+        env = _endorser_env(f"t{i}", _rw(writes=[("cc", f"k{i}",
+                                                  b"v%d" % i)]))
+        b = _block(i, prev, [env])
+        blocks.append(b)
+        prev = protoutil.block_header_hash(b.header)
+    return blocks
+
+
+def test_kvledger_crash_point_sits_between_blockstore_and_state(tmp_path):
+    """The armed fault kills commit_block with the block durable in
+    the block store but ABSENT from state — the exact skew _recover()
+    exists for."""
+    d = str(tmp_path / "ch")
+    led = KvLedger(d, "ch")
+    blocks = _mkblocks(3)
+    for b in blocks[:2]:
+        led.commit_block(b)
+    plan = faults.FaultPlan().add("peer.ledger.crash", nth=1)
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedFault):
+            led.commit_block(blocks[2])
+    # block store took the block; statedb never saw its write
+    assert led.blockstore.height == 3
+    assert led.new_query_executor().get_state("cc", "k2") is None
+    # the crashed ledger is deliberately ABANDONED: no close(), no
+    # checkpoint — exactly what a process kill leaves behind
+    # (`led` stays referenced so no finalizer flushes its buffers)
+
+
+def test_kvledger_hard_crash_reopen_matches_uncrashed_peer(tmp_path):
+    """The acceptance differential: a peer hard-crashed mid-commit
+    reopens on its own dirs, replays statedb-behind-blockstore, and
+    reaches the same state fingerprint as a peer that never crashed —
+    with the incremental XOR fingerprint agreeing with the
+    full-rescan oracle."""
+    blocks = _mkblocks(5)
+    crash_dir = str(tmp_path / "crash")
+    clean_dir = str(tmp_path / "clean")
+    crashed = KvLedger(crash_dir, "ch")
+    clean = KvLedger(clean_dir, "ch")
+    for b in blocks[:4]:
+        crashed.commit_block(b)
+        clean.commit_block(b)
+    plan = faults.FaultPlan().add("peer.ledger.crash", nth=1)
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedFault):
+            crashed.commit_block(blocks[4])
+    clean.commit_block(blocks[4])
+
+    # reopen over the abandoned dirs: _recover() must replay block 4
+    # into statedb/history and fold its delta into the incremental
+    # fingerprint
+    reopened = KvLedger(crash_dir, "ch")
+    try:
+        assert reopened.height == 5 == clean.height
+        assert reopened.new_query_executor().get_state("cc", "k4") == b"v4"
+        assert reopened.state_fingerprint() == \
+            reopened.state_fingerprint_full()
+        assert reopened.state_fingerprint() == clean.state_fingerprint()
+        assert reopened.history.get_history_for_key("cc", "k4") == [(4, 0)]
+    finally:
+        reopened.close()
+        clean.close()
+
+
+def test_raft_wal_crash_keeps_synced_prefix_drops_unsynced_tail(tmp_path):
+    """`orderer.wal.crash` fires after the frame write but before any
+    flush/fsync: the synced prefix (everything that could have been
+    acked) survives the reopen; the in-buffer tail — never covered by
+    a durability barrier, so never acked — is gone or cropped."""
+    path = str(tmp_path / "n1.wal")
+    wal = RaftWAL(path)
+    wal.save_hardstate(3, "n2")
+    for i in range(1, 6):
+        wal.append(i, 3, b"e%d" % i)       # inline mode: synced each
+    synced = list(wal.entries)
+    plan = faults.FaultPlan().add("orderer.wal.crash", nth=1)
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedFault):
+            wal.append(6, 3, b"doomed")
+    # abandon WITHOUT close(): `wal` stays referenced so the buffered
+    # doomed frame is never flushed by a finalizer
+
+    revived = RaftWAL(path)
+    assert revived.term == 3 and revived.voted_for == "n2"
+    assert revived.entries == synced       # acked prefix, bit-exact
+    assert revived.last_index == 5         # the doomed entry never
+    revived.close()                        # surfaced
+
+
+def test_raft_wal_torn_tail_cropped_and_appendable(tmp_path):
+    """A physically torn final frame (half-written at power loss) is
+    cropped by CRC replay AND truncated from the file, so post-restart
+    appends land on a clean end instead of behind unreadable bytes."""
+    path = str(tmp_path / "n1.wal")
+    wal = RaftWAL(path)
+    for i in range(1, 4):
+        wal.append(i, 1, b"e%d" % i)
+    wal.close()
+    with open(path, "ab") as f:            # a torn frame: valid
+        f.write(struct.pack("<II", 64, 0xDEAD) + b"partial")
+
+    revived = RaftWAL(path)
+    assert [d for _, d in revived.entries] == [b"e1", b"e2", b"e3"]
+    revived.append(4, 1, b"after")         # lands after the crop
+    revived.close()
+
+    again = RaftWAL(path)
+    assert [d for _, d in again.entries] == [b"e1", b"e2", b"e3",
+                                             b"after"]
+    again.close()

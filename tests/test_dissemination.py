@@ -359,3 +359,61 @@ def test_demoted_root_stops_pushing_promotion_resumes():
     assert not svc._is_root
     with svc.relay._lock:
         assert not any(svc.relay._queues.values())
+
+
+# ---------------------------------------------------------------------------
+# Epoch rotation under churn (PR 20): membership changes advance the
+# epoch, so the tree actually re-forms instead of freezing the old
+# interior under the plumbed-but-static epoch
+# ---------------------------------------------------------------------------
+
+def _bare_service(endpoint="r:7051"):
+    from fabric_mod_tpu.concurrency.locks import RegisteredLock
+    svc = RelayService.__new__(RelayService)
+    svc._node = _fake_node(endpoint)
+    svc._lock = RegisteredLock("dissemination.service._lock")
+    svc._epoch = 0
+    svc._epoch_members = None
+    return svc
+
+
+def test_relay_epoch_advances_on_membership_change():
+    svc = _bare_service()
+    svc._note_membership(["r:7051", "a:7051", "b:7051"])
+    assert svc.epoch == 0                  # first view only seeds
+    svc._note_membership(["a:7051", "r:7051", "b:7051"])
+    assert svc.epoch == 0                  # reordering is not churn
+    svc._note_membership(["r:7051", "a:7051"])       # crash expiry
+    assert svc.epoch == 1
+    svc._note_membership(["r:7051", "a:7051", "b:7051"])  # rejoin
+    assert svc.epoch == 2
+    assert svc.bump_epoch() == 3           # the world's heal hook
+
+
+def test_relay_tree_reparents_after_crash_rejoin_churn():
+    """A crash-expiry + rejoin cycle leaves the member SET identical
+    but must still re-deal the interior: both flips advanced the
+    epoch, and the reparent plan between the pre-churn and post-churn
+    trees is non-empty and internally consistent."""
+    eps = [f"p{i}:7051" for i in range(1, 9)]
+    svc = _bare_service("p0:7051")
+    svc._degree = 2
+    svc._leader_source = lambda: "p0:7051"
+    alive = [types.SimpleNamespace(endpoint=e) for e in eps]
+    svc._node.discovery = types.SimpleNamespace(
+        alive_members=lambda: list(alive))
+    t0 = svc.tree()
+    assert svc.epoch == 0
+    dead = alive.pop()                     # a member crash-expires
+    during = svc.tree()
+    assert svc.epoch == 1
+    assert dead.endpoint not in during
+    alive.append(dead)                     # ...and rejoins
+    t1 = svc.tree()
+    assert svc.epoch == 2
+    assert set(t1.order) == set(t0.order)
+    plan = reparent_plan(t0, t1)
+    assert plan                            # interior genuinely moved
+    for member, (was, now) in plan.items():
+        assert t0.parent(member) == was
+        assert t1.parent(member) == now
